@@ -1,0 +1,58 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability surface of Horovod v0.11.2 (reference: ``/root/reference``).
+
+Public API parity with ``horovod/tensorflow/__init__.py:34-43``::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    hvd.size(); hvd.rank(); hvd.local_rank()
+    hvd.allreduce(t); hvd.allgather(t); hvd.broadcast(t, root_rank)
+    hvd.broadcast_global_variables(params, root_rank)
+    opt = hvd.DistributedOptimizer(optax_optimizer)
+
+Design: the world is a 1-D ``jax.sharding.Mesh`` over every chip (axis
+``"hvd"``); collectives are XLA collectives over ICI inside compiled code and
+cached compiled dispatches (single-controller) or a host DCN coordination
+plane (multi-process) eagerly. See ``runtime.py`` and ``ops/``.
+"""
+
+from .version import __version__  # noqa: F401
+
+from .runtime import (  # noqa: F401
+    AXIS,
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_rank,
+    process_index,
+    process_count,
+    mesh,
+    world,
+)
+from .ops.collectives import (  # noqa: F401
+    Op,
+    allreduce,
+    allgather,
+    allgather_ragged,
+    broadcast,
+    alltoall,
+    reducescatter,
+    grouped_allreduce,
+)
+from .ops.sparse import IndexedSlices  # noqa: F401
+from .optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    allreduce_gradients,
+    broadcast_global_variables,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+)
+from .exceptions import (  # noqa: F401
+    HorovodError,
+    NotInitializedError,
+    FailedPreconditionError,
+    TransportError,
+    StalledError,
+)

@@ -1,0 +1,29 @@
+"""Host coordination client — the multi-process eager control/data plane.
+
+This is the TPU-native analog of the reference's background-thread MPI
+negotiation (``BackgroundThreadLoop``, ``mpi_ops.cc:1248-1512``): name-keyed
+Request/Response messages to a rank-0 coordinator over DCN/TCP, cross-rank
+validation with the reference's error taxonomy, stall detection, and host-side
+execution of eager op-at-a-time collectives.
+
+Implemented in ``horovod_tpu/coord/`` (C++ core + this Python binding).
+"""
+
+from __future__ import annotations
+
+
+class CoordClient:
+    """Placeholder until the native coordination core lands.
+
+    Compiled collectives (``shard_map`` over the global mesh) already span
+    processes via XLA — only the *eager* op-at-a-time API needs this plane.
+    ``init(coordinator=False)`` disables it explicitly.
+    """
+
+    @classmethod
+    def from_env(cls, rank: int, size: int, timeline=None) -> "CoordClient":
+        raise NotImplementedError(
+            "the multi-process eager coordination plane is not built yet; "
+            "compiled collectives (shard_map over the world mesh) already "
+            "span processes — pass init(coordinator=False) to proceed "
+            "without eager op-at-a-time collectives")

@@ -1,0 +1,56 @@
+"""Error taxonomy.
+
+Mirrors the reference's error surface:
+
+* not-initialized errors from the C ABI (``mpi_ops.cc:1530-1536`` —
+  ``CheckInitialized`` returns FailedPrecondition "Horovod has not been
+  initialized").
+* cross-rank mismatch errors produced by coordinator validation
+  (``ConstructMPIResponse``, ``mpi_ops.cc:266-474``) which surface to the
+  calling op as ``tf.errors.FailedPreconditionError``.
+* transport/library failures (``MPI_CHECK``/``CUDA_CHECK``/``NCCL_CHECK``,
+  ``mpi_ops.cc:535-572``) which surface as Unknown errors.
+"""
+
+
+class HorovodError(Exception):
+    """Base class for all framework errors."""
+
+
+class NotInitializedError(HorovodError):
+    """Raised when the process API is used before ``init()``.
+
+    Parity: ``mpi_ops.py:85-88`` raises ValueError('Horovod has not been
+    initialized; use hvd.init().'); the C side returns -1
+    (``mpi_ops.cc:1539-1566``).
+    """
+
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; use horovod_tpu.init()."
+        )
+
+
+class FailedPreconditionError(HorovodError):
+    """Cross-rank inconsistency detected during collective negotiation.
+
+    Parity: the ERROR response path of ``ConstructMPIResponse``
+    (``mpi_ops.cc:266-474``) → ``PerformOperation`` ERROR branch
+    (``mpi_ops.cc:1141-1148``) → TF FailedPreconditionError on every rank.
+    """
+
+
+class TransportError(HorovodError):
+    """Failure in the host coordination transport (DCN/TCP plane).
+
+    Parity: ``MPI_CHECK`` converting MPI failures to errors::Unknown
+    (``mpi_ops.cc:535-546``).
+    """
+
+
+class StalledError(HorovodError):
+    """A collective waited past the hard stall deadline (optional strict mode).
+
+    The reference only warns (``CheckForStalledTensors``,
+    ``mpi_ops.cc:1153-1196``); we additionally support a hard timeout.
+    """

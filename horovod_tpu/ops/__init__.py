@@ -1,0 +1,14 @@
+"""Collective ops (the data plane) — TPU-native analog of the reference's
+``horovod/tensorflow/mpi_ops.py`` + ``mpi_ops.cc`` kernels."""
+
+from .collectives import (  # noqa: F401
+    Op,
+    allreduce,
+    allgather,
+    broadcast,
+    alltoall,
+    reducescatter,
+    grouped_allreduce,
+)
+from .fusion import plan_buckets, fused_allreduce  # noqa: F401
+from .sparse import IndexedSlices, allreduce_indexed_slices  # noqa: F401

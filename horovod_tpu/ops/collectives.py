@@ -1,0 +1,325 @@
+"""Named collectives: allreduce / allgather / broadcast (+ TPU-era extras).
+
+Reference parity
+----------------
+* Graph-op wrappers ``_allreduce/allgather/broadcast`` with auto-generated
+  cross-rank matching names (``mpi_ops.py:127-190``); semantic ``allreduce``
+  with average-vs-sum and the sparse path (``horovod/tensorflow/__init__.py:
+  43-79``); ``HorovodAllreduce/Allgather/Broadcast`` kernels
+  (``mpi_ops.cc:1752-1915``).
+* Allgather concatenates along the first dimension (``MPI_Allgatherv``
+  executor, ``mpi_ops.cc:735-812``).
+* Broadcast takes a ``root_rank`` and the root's tensor passes through
+  (``mpi_ops.cc:1855-1893``).
+
+TPU-native design
+-----------------
+Two execution contexts, one API:
+
+1. **Inside compiled code** (``shard_map`` over the world mesh — the hot
+   path, used by ``DistributedOptimizer`` inside the jitted train step):
+   the call lowers directly to an XLA collective over the ``"hvd"`` ICI axis
+   (``lax.psum`` / ``lax.all_gather`` / one-hot-mask ``psum`` broadcast).
+   XLA schedules and overlaps these; no negotiation is needed because SPMD
+   tracing already imposes one global order (SURVEY §7 design stance —
+   the reference's coordinator exists only because TF 1.x graph execution is
+   cross-rank nondeterministic, ``mpi_ops.cc:1198-1247``).
+
+2. **Eager, op-at-a-time** (outside jit — metrics averaging, epoch
+   broadcast, checkpoint-resume sync): the call is dispatched through a
+   cached single-collective executable on the mesh. Per-rank inputs are
+   jax.Arrays sharded over the world axis on their leading dim (the
+   single-controller encoding of "each rank passes its own tensor");
+   replicated/host inputs mean every rank contributes the same value. In
+   multi-process mode the host coordination plane (``horovod_tpu.coord``)
+   additionally validates name-keyed requests across processes, with the
+   reference's exact error taxonomy (``ConstructMPIResponse``,
+   ``mpi_ops.cc:266-474``).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import runtime
+from ..runtime import AXIS
+from ..utils.compat import all_gather_invariant
+
+
+class Op(enum.Enum):
+    """Reduction op. The reference supports summation with optional
+    averaging (``average=`` bool, ``horovod/tensorflow/__init__.py:43``);
+    MIN/MAX/PRODUCT are TPU-era extras."""
+
+    SUM = "sum"
+    AVERAGE = "average"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+
+
+_name_counter = 0
+
+
+def _auto_name(kind: str, name: Optional[str]) -> str:
+    """Auto-generate the cross-rank matching key (parity: ``mpi_ops.py:132-145``
+    names ops ``HorovodAllreduce_<sanitized tensor name>``)."""
+    global _name_counter
+    if name is None:
+        _name_counter += 1
+        name = f"tensor_{_name_counter}"
+    return f"Horovod{kind}_" + re.sub(r"[^a-zA-Z0-9_]", "_", str(name))
+
+
+def _in_trace() -> bool:
+    return runtime._in_world_trace()
+
+
+# ---------------------------------------------------------------------------
+# In-trace primitives (compiled data plane over ICI).
+# ---------------------------------------------------------------------------
+
+def _reduce_in_trace(x, op: Op, axis_name: str = AXIS):
+    if op is Op.AVERAGE:
+        return lax.pmean(x, axis_name)
+    if op is Op.SUM:
+        return lax.psum(x, axis_name)
+    if op is Op.MIN:
+        return lax.pmin(x, axis_name)
+    if op is Op.MAX:
+        return lax.pmax(x, axis_name)
+    if op is Op.PRODUCT:
+        # No lax.pprod; exp/log is lossy — use all_gather+prod (rarely hot).
+        return jnp.prod(all_gather_invariant(x, axis_name), axis=0)
+    raise ValueError(f"unknown op {op}")
+
+
+def _broadcast_in_trace(x, root_rank: int, axis_name: str = AXIS):
+    """One-hot-mask ``psum`` broadcast (SURVEY §2.5 TPU equivalent of
+    ``MPI_Bcast``, ``mpi_ops.cc:1134-1136``): zero everywhere but the root,
+    then sum over the axis. The root's tensor passes through bit-exact for
+    ints; for floats, +0.0 of zeros is exact."""
+    idx = lax.axis_index(axis_name)
+    orig_dtype = x.dtype
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int8)
+    # where(), not x*mask: multiply-by-zero would propagate NaN/Inf from
+    # non-root ranks — and re-syncing diverged replicas is broadcast's main
+    # job (§5.4 consistency protocol).
+    out = lax.psum(jnp.where(idx == root_rank, x, jnp.zeros_like(x)),
+                   axis_name)
+    return out.astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch: cached single-collective executables on the world mesh.
+# Parity note: the reference caches nothing (every session.run re-hits the
+# negotiation); we cache compiled executables per (kind, shape, dtype, flags)
+# — SURVEY §7 "per-(shape,dtype) executable caching".
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _eager_fn(mesh_key, kind: str, per_rank: bool, squeeze: bool, op: Op,
+              root_rank: int):
+    mesh = runtime.mesh()
+    in_spec = P(AXIS) if per_rank else P()
+
+    if kind == "allreduce":
+        def f(x):
+            return _reduce_in_trace(x, op)
+    elif kind == "allgather":
+        def f(x):
+            return all_gather_invariant(x, AXIS, tiled=True)
+    elif kind == "broadcast":
+        def f(x):
+            return _broadcast_in_trace(x, root_rank)
+    else:
+        raise ValueError(kind)
+
+    if squeeze:
+        # Stacked per-rank encoding: the [size, ...] leading axis shards to a
+        # size-1 block per rank; the rank's tensor is block[0].
+        inner = f
+        f = lambda x: inner(x[0])  # noqa: E731
+
+    return jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=P()))
+
+
+def _is_per_rank(x) -> bool:
+    """A jax.Array whose leading dim is split over the world axis encodes
+    "each rank passes its own tensor" under a single controller."""
+    sharding = getattr(x, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return False
+    spec = sharding.spec
+    return len(spec) > 0 and (
+        spec[0] == AXIS or (isinstance(spec[0], tuple) and AXIS in spec[0]))
+
+
+def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
+                    root_rank: int = 0):
+    w = runtime.world()
+    x = jnp.asarray(x)
+    per_rank = _is_per_rank(x)
+
+    if w.coord is not None:
+        # Multi-process eager plane: negotiate + validate the name-keyed
+        # request across processes before dispatch (host DCN plane).
+        return w.coord.collective(kind, x, name, op=op, root_rank=root_rank)
+
+    tl = w.timeline
+    if tl is not None:
+        tl.negotiate_instant(name, kind.upper(), ready_ranks=range(w.size))
+        tl.start(name, kind.upper())
+    squeeze = per_rank and x.ndim >= 1 and x.shape[0] == w.size
+    fn = _eager_fn(runtime._generation, kind, per_rank, squeeze, op, root_rank)
+    out = fn(x)
+    if tl is not None:
+        tl.end(name, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Public API.
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              op: Optional[Op] = None, axis_name: str = AXIS):
+    """Sum (or average) ``tensor`` across all ranks.
+
+    Parity: ``hvd.allreduce`` (``horovod/tensorflow/__init__.py:43-79``) —
+    ``average=True`` divides by ``size()``. The sparse
+    ``tf.IndexedSlices`` branch (allgather of values+indices,
+    ``__init__.py:61-72``) lives in :func:`horovod_tpu.ops.sparse.
+    allreduce_indexed_slices` and is auto-taken for
+    :class:`~horovod_tpu.ops.sparse.IndexedSlices` inputs.
+
+    Inside a ``shard_map`` over the world mesh this is a single XLA
+    ``all-reduce`` over ICI; eagerly it dispatches a cached compiled
+    collective (single-controller) or the host coordination plane
+    (multi-process).
+    """
+    from .sparse import IndexedSlices, allreduce_indexed_slices
+    resolved = op if op is not None else (Op.AVERAGE if average else Op.SUM)
+    if isinstance(tensor, IndexedSlices):
+        if resolved not in (Op.SUM, Op.AVERAGE):
+            raise ValueError(
+                f"op={resolved} is not supported for sparse (IndexedSlices) "
+                "allreduce; the sliced form only composes under SUM/AVERAGE "
+                "(reference semantics, horovod/tensorflow/__init__.py:61-72)")
+        return allreduce_indexed_slices(
+            tensor, average=(resolved is Op.AVERAGE), name=name)
+
+    if _in_trace():
+        return _reduce_in_trace(tensor, resolved, axis_name)
+    return _eager_dispatch("allreduce", tensor,
+                           _auto_name("Allreduce", name), op=resolved)
+
+
+def allgather(tensor, name: Optional[str] = None, axis_name: str = AXIS):
+    """Concatenate each rank's tensor along dim 0.
+
+    Parity: ``hvd.allgather`` (``mpi_ops.py:151-167``) / ``MPI_Allgatherv``
+    executor (``mpi_ops.cc:735-812``). Ranks may differ in the first
+    dimension only — in compiled SPMD code shapes are static and equal; the
+    variable-first-dim case is served eagerly by the coordination plane
+    (negotiated sizes, ``mpi_ops.cc:345-405``) or in-trace via
+    :func:`allgather_ragged`.
+    """
+    if _in_trace():
+        return all_gather_invariant(tensor, axis_name, tiled=True)
+    return _eager_dispatch("allgather", tensor, _auto_name("Allgather", name))
+
+
+def allgather_ragged(tensor, valid_size, max_size: int,
+                     name: Optional[str] = None, axis_name: str = AXIS):
+    """Variable-first-dim allgather under XLA static shapes.
+
+    Each rank holds ``tensor`` padded to ``max_size`` rows, of which
+    ``valid_size`` are real. Returns ``(gathered, sizes)`` where
+    ``gathered`` is ``[size * max_size, ...]`` with each rank's block
+    zero-padded past its ``valid_size``, and ``sizes`` is the per-rank
+    valid-size vector — the in-trace analog of the negotiated
+    ``tensor_sizes`` in the reference's allgather response
+    (``mpi_message.h:94-139``, ``mpi_ops.cc:345-405``).
+    """
+    del name
+    n = jnp.shape(tensor)[0]
+    if n != max_size:
+        pad = [(0, max_size - n)] + [(0, 0)] * (tensor.ndim - 1)
+        tensor = jnp.pad(tensor, pad)
+    row = jnp.arange(max_size)
+    keep = (row < valid_size).reshape((max_size,) + (1,) * (tensor.ndim - 1))
+    tensor = jnp.where(keep, tensor, jnp.zeros_like(tensor))
+    gathered = all_gather_invariant(tensor, axis_name, tiled=True)
+    sizes = all_gather_invariant(jnp.asarray(valid_size, jnp.int32), axis_name)
+    return gathered, sizes
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              axis_name: str = AXIS):
+    """Every rank receives the root's tensor.
+
+    Parity: ``hvd.broadcast`` (``mpi_ops.py:170-190``) / ``MPI_Bcast``
+    executor (``mpi_ops.cc:1113-1140``; root passes input through,
+    ``mpi_ops.cc:1869-1870``).
+    """
+    if runtime.is_initialized() and not 0 <= root_rank < runtime.size():
+        # Parity: the coordinator validates root_rank (ConstructMPIResponse,
+        # mpi_ops.cc:408-435); an impossible root must fail loudly, not
+        # silently produce zeros from an all-false mask.
+        raise ValueError(
+            f"root_rank {root_rank} is out of range for world size "
+            f"{runtime.size()}")
+    if _in_trace():
+        return _broadcast_in_trace(tensor, root_rank, axis_name)
+    return _eager_dispatch("broadcast", tensor,
+                           _auto_name("Broadcast", name), root_rank=root_rank)
+
+
+def alltoall(tensor, split_axis: int = 0, concat_axis: int = 0,
+             name: Optional[str] = None, axis_name: str = AXIS):
+    """All-to-all exchange (TPU-era extra; not in reference v0.11.2 —
+    needed by all-to-all sequence/context parallelism, SURVEY §5.7)."""
+    del name
+    if _in_trace():
+        return lax.all_to_all(tensor, axis_name, split_axis, concat_axis,
+                              tiled=True)
+    raise NotImplementedError("alltoall is compiled-only; call under "
+                              "shard_map over the world mesh")
+
+
+def reducescatter(tensor, name: Optional[str] = None, axis_name: str = AXIS):
+    """Reduce-scatter (TPU-era extra): psum then shard dim 0 across ranks."""
+    del name
+    if _in_trace():
+        return lax.psum_scatter(tensor, axis_name, tiled=True)
+    raise NotImplementedError("reducescatter is compiled-only; call under "
+                              "shard_map over the world mesh")
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None,
+                      fusion_threshold: Optional[int] = None,
+                      axis_name: str = AXIS):
+    """Allreduce a pytree of tensors as fused flat buckets.
+
+    This is the TPU-native tensor fusion (reference: coordinator-side fusion
+    of consecutive same-dtype responses into one 64 MiB-capped buffer,
+    ``mpi_ops.cc:1395-1422``; semantics doc ``docs/tensor-fusion.md:6-28``).
+    See :mod:`horovod_tpu.ops.fusion`.
+    """
+    from .fusion import fused_allreduce
+    del name
+    return fused_allreduce(tensors, average=average,
+                           fusion_threshold=fusion_threshold,
+                           axis_name=axis_name)

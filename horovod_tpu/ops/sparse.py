@@ -1,0 +1,70 @@
+"""Sparse (embedding) gradients: the ``tf.IndexedSlices`` path.
+
+Reference semantics (``horovod/tensorflow/__init__.py:61-72``): a sparse
+gradient is a (values, indices) pair; its "allreduce" is **two allgathers**
+(values and indices) — an allreduce in sliced form — with optional division
+of values by ``size()``. Exercised by the word2vec example
+(``examples/tensorflow_word2vec.py:218-222``).
+
+TPU-native: under SPMD the per-rank slice counts are equal and static, so the
+gathers are plain ``lax.all_gather`` (tiled). The gathered IndexedSlices may
+contain duplicate indices across ranks — exactly like the reference — and
+summation happens when applied to the dense variable (``to_dense`` uses a
+scatter-add, matching TF's IndexedSlices application semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..runtime import AXIS
+from ..utils.compat import all_gather_invariant
+
+
+@jax.tree_util.register_pytree_node_class
+class IndexedSlices:
+    """A sparse gradient: ``dense[indices[i]] += values[i]``.
+
+    Parity: ``tf.IndexedSlices`` as consumed by the reference's sparse
+    allreduce branch (``horovod/tensorflow/__init__.py:61-72``).
+    """
+
+    def __init__(self, values, indices, dense_shape: Tuple[int, ...]):
+        self.values = values
+        self.indices = indices
+        self.dense_shape = tuple(dense_shape)
+
+    def tree_flatten(self):
+        return (self.values, self.indices), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, dense_shape, children):
+        values, indices = children
+        return cls(values, indices, dense_shape)
+
+    def to_dense(self) -> jax.Array:
+        """Scatter-add into a dense array (TF IndexedSlices application)."""
+        dense = jnp.zeros(self.dense_shape, dtype=self.values.dtype)
+        return dense.at[self.indices].add(self.values)
+
+    def __repr__(self):
+        return (f"IndexedSlices(values={self.values.shape}, "
+                f"indices={self.indices.shape}, dense_shape={self.dense_shape})")
+
+
+def allreduce_indexed_slices(slices: IndexedSlices, average: bool = True,
+                             name: Optional[str] = None,
+                             axis_name: str = AXIS) -> IndexedSlices:
+    """Sparse allreduce = allgather(values) + allgather(indices)
+    (``horovod/tensorflow/__init__.py:61-72``), values scaled by
+    ``1/size`` when averaging."""
+    del name
+    values = all_gather_invariant(slices.values, axis_name, tiled=True)
+    indices = all_gather_invariant(slices.indices, axis_name, tiled=True)
+    if average:
+        values = values / lax.psum(1, axis_name)
+    return IndexedSlices(values, indices, slices.dense_shape)

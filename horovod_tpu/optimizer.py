@@ -1,0 +1,124 @@
+"""DistributedOptimizer and variable broadcast — the framework adapter layer.
+
+Reference parity
+----------------
+* ``hvd.DistributedOptimizer`` wraps any ``tf.train.Optimizer`` and
+  allreduces each gradient before the wrapped optimizer applies it, only when
+  ``size() > 1`` (``horovod/tensorflow/__init__.py:127-226``); the Keras
+  variant dynamically subclasses the user's optimizer class so checkpoints
+  restore without Horovod installed (``horovod/keras/__init__.py:66-87``).
+* ``hvd.broadcast_global_variables(root)`` = grouped assign of
+  ``broadcast(var, root)`` over every variable
+  (``horovod/tensorflow/__init__.py:82-90``);
+  ``BroadcastGlobalVariablesHook`` runs it right after session creation
+  (``__init__.py:93-124``).
+
+TPU-native design
+-----------------
+The optimizer layer is an **optax gradient transformation**: composable,
+functional, and jit-traceable. ``DistributedOptimizer(opt)`` returns an optax
+``GradientTransformation`` whose ``update`` first allreduces gradients over
+the ``"hvd"`` ICI axis — with reference-semantics fusion bucketing
+(64 MiB / same-dtype / order-preserving, see ``ops/fusion.py``) — then
+defers to the wrapped transformation. Sparse gradients
+(:class:`~horovod_tpu.ops.sparse.IndexedSlices` leaves) take the
+two-allgather path (``horovod/tensorflow/__init__.py:61-72``) unless
+``sparse_as_dense=True`` densifies them first.
+
+Because optax state is a pure pytree, the Keras "dynamic subclass"
+checkpoint-compatibility trick has a simpler equivalent: the wrapped
+transformation's state **is** the inner optimizer's state, unchanged, so
+checkpoints restore with plain optax, without this framework installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+
+from . import runtime
+from .ops.collectives import broadcast as _broadcast
+from .ops.fusion import fused_allreduce
+from .ops.sparse import IndexedSlices, allreduce_indexed_slices
+from .runtime import AXIS
+
+
+def _is_sparse_leaf(x) -> bool:
+    return isinstance(x, IndexedSlices)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         *,
+                         average: bool = True,
+                         fusion_threshold: Optional[int] = None,
+                         sparse_as_dense: bool = False,
+                         axis_name: str = AXIS
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with fused gradient allreduce.
+
+    Parity: ``hvd.DistributedOptimizer`` (``horovod/tensorflow/__init__.py:
+    127-186``) — gradients are averaged across ranks before being applied;
+    a no-op when ``size() == 1`` (``__init__.py:180-182``). Call inside the
+    jitted train step under ``shard_map`` over the world mesh.
+    """
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(grads, state, params=None, **extra):
+        grads = allreduce_gradients(
+            grads, average=average, fusion_threshold=fusion_threshold,
+            sparse_as_dense=sparse_as_dense, axis_name=axis_name)
+        return optimizer.update(grads, state, params, **extra)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def allreduce_gradients(grads,
+                        average: bool = True,
+                        fusion_threshold: Optional[int] = None,
+                        sparse_as_dense: bool = False,
+                        axis_name: str = AXIS):
+    """Allreduce a gradient pytree: dense leaves via fused flat buckets,
+    sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``)."""
+    if runtime.is_initialized() and runtime.size() == 1 \
+            and not runtime._in_world_trace():
+        return grads  # size()==1 fast path (__init__.py:180-182)
+
+    if sparse_as_dense:
+        grads = jax.tree_util.tree_map(
+            lambda l: l.to_dense() if _is_sparse_leaf(l) else l,
+            grads, is_leaf=_is_sparse_leaf)
+    # fused_allreduce buckets dense leaves and routes IndexedSlices leaves
+    # through the two-allgather sparse path.
+    return fused_allreduce(grads, average=average,
+                           fusion_threshold=fusion_threshold,
+                           axis_name=axis_name)
+
+
+def broadcast_global_variables(variables, root_rank: int = 0,
+                               axis_name: str = AXIS):
+    """Broadcast every leaf of a pytree from ``root_rank``.
+
+    Parity: ``hvd.broadcast_global_variables``
+    (``horovod/tensorflow/__init__.py:82-90``) — used right after
+    initialization or checkpoint restore so all ranks start from rank 0's
+    weights (§5.4 consistency protocol).
+    """
+    return jax.tree_util.tree_map(
+        lambda v: _broadcast(v, root_rank=root_rank, axis_name=axis_name),
+        variables)
+
+
+# Alias matching modern naming; same semantics.
+broadcast_parameters = broadcast_global_variables
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              axis_name: str = AXIS):
+    """Broadcast optimizer state (momenta etc.) from ``root_rank`` — the
+    optax analog of broadcasting optimizer slot variables, which the
+    reference gets for free because slots are global variables
+    (``horovod/tensorflow/__init__.py:82-90``)."""
+    return broadcast_global_variables(opt_state, root_rank, axis_name)

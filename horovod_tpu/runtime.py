@@ -1,0 +1,252 @@
+"""World bootstrap and process API: ``init / shutdown / size / rank / local_rank``.
+
+Reference parity
+----------------
+* ``hvd.init()`` → ``InitializeHorovodOnce`` (``mpi_ops.cc:1516-1527``):
+  idempotent via an atomic flag, spawns the background runtime, and the caller
+  waits until initialization is done. Here, ``init()`` is idempotent under a
+  lock, builds the global device **mesh** (the TPU-native "world"), and —
+  in multi-process mode — starts the host coordination client (DCN control
+  plane), the analog of the reference's background MPI thread
+  (``BackgroundThreadLoop``, ``mpi_ops.cc:1248-1512``).
+* ``size()/rank()/local_rank()`` → C ABI ``horovod_tensorflow_{size,rank,
+  local_rank}`` (``mpi_ops.cc:1539-1566``), raising when uninitialized
+  (``mpi_ops.py:80-124``).
+
+TPU-native design
+-----------------
+Horovod's world is "1 MPI process = 1 GPU" (``README.md:62-64``). The
+TPU-native world is a 1-D ``jax.sharding.Mesh`` over every chip of the slice,
+with axis name ``"hvd"``:
+
+* ``size()``  = number of chips in the mesh (== MPI world size).
+* ``rank()``  = chip index. Inside compiled code (``shard_map`` over the mesh)
+  this is ``lax.axis_index('hvd')`` — a per-chip value, exactly Horovod's
+  per-process rank. Outside compiled code, a controller process "speaks for"
+  its local chips and ``rank()`` returns the global index of its first local
+  chip (so launched one-process-per-chip by ``tpurun``, it equals the MPI
+  rank; single-controller, it is 0).
+* ``local_rank()`` = index of the chip among chips on the same host — the
+  analog of ``MPI_Comm_split_type(MPI_COMM_TYPE_SHARED)`` rank
+  (``mpi_ops.cc:1263-1267``) — derived from launcher env or the process's
+  local device list.
+
+Multi-host: when the launcher has set up ``jax.distributed``, ``jax.devices()``
+spans every process, compiled collectives ride ICI/DCN automatically, and the
+mesh is global. No NCCL-style communicator bootstrap is needed: ICI collectives
+are compiler-scheduled (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .exceptions import NotInitializedError
+from .utils import config as _config
+
+# The world axis name. Every collective in this framework reduces over it.
+AXIS: str = "hvd"
+
+
+@dataclasses.dataclass(frozen=True)
+class World:
+    """Global state (parity: ``HorovodGlobalState``, ``mpi_ops.cc:132-216``).
+
+    Unlike the reference — whose global state carries a tensor table, message
+    queue and CUDA stream pool — the compiled data plane needs only the mesh;
+    the eager control plane (coordination client, timeline) hangs off this
+    object when enabled.
+    """
+
+    mesh: Mesh
+    size: int
+    controller_rank: int        # global index of this process's first device
+    local_rank: int
+    process_index: int
+    process_count: int
+    coord: Any = None           # coordination client (multi-process eager plane)
+    timeline: Any = None        # Timeline writer (rank 0 only)
+
+
+_lock = threading.Lock()
+_world: Optional[World] = None
+# Monotonic world generation — bumped on every init(); used (instead of
+# object identity, which can be reused after GC) to key caches of compiled
+# collective executables across shutdown/re-init cycles.
+_generation = 0
+
+
+def init(devices: Optional[Sequence[jax.Device]] = None,
+         *,
+         coordinator: bool | None = None) -> World:
+    """Initialize the world. Idempotent (parity: ``mpi_ops.cc:1516-1527``).
+
+    Args:
+      devices: explicit device list forming the world (defaults to every
+        device visible to JAX — all chips of the slice across processes).
+      coordinator: force-enable/disable the host coordination service for the
+        eager op-at-a-time path. Default: enabled iff multi-process.
+    """
+    global _world, _generation
+    with _lock:
+        if _world is not None:
+            return _world
+        _generation += 1
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        mesh = Mesh(np.array(devs), (AXIS,))
+        size = len(devs)
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+
+        # Controller rank: global index of the first device owned by this
+        # process. One-process-per-chip (tpurun) → this is the MPI-style rank.
+        controller_rank = 0
+        for i, d in enumerate(devs):
+            if d.process_index == process_index:
+                controller_rank = i
+                break
+
+        local_rank = _config.launcher_local_rank(default=_infer_local_rank(devs, process_index))
+
+        timeline = None
+        tl_path = _config.timeline_path()
+        if tl_path and controller_rank == 0:
+            from .utils.timeline import Timeline
+            timeline = Timeline(tl_path)
+
+        coord = None
+        if coordinator is None:
+            coordinator = process_count > 1
+        elif coordinator and process_count == 1:
+            raise ValueError(
+                "init(coordinator=True) requires a multi-process world; "
+                "single-controller mode has no cross-process negotiation "
+                "to coordinate")
+        if coordinator and process_count > 1:
+            from .coord.client import CoordClient
+            coord = CoordClient.from_env(
+                rank=process_index, size=process_count, timeline=timeline)
+
+        _world = World(
+            mesh=mesh,
+            size=size,
+            controller_rank=controller_rank,
+            local_rank=local_rank,
+            process_index=process_index,
+            process_count=process_count,
+            coord=coord,
+            timeline=timeline,
+        )
+        return _world
+
+
+def _infer_local_rank(devs: Sequence[jax.Device], process_index: int) -> int:
+    """Chips-per-host index (parity: shared-comm split, mpi_ops.cc:1263-1267)."""
+    try:
+        first_local = next(d for d in devs if d.process_index == process_index)
+    except StopIteration:
+        return 0
+    lid = getattr(first_local, "local_hardware_id", None)
+    if lid is not None and lid >= 0:
+        return int(lid)
+    return 0
+
+
+def shutdown() -> None:
+    """Tear the world down (parity: ``HorovodGlobalState`` destructor →
+    SHUTDOWN broadcast → ``MPI_Finalize``; ``mpi_ops.cc:207-215, 1437-1447,
+    1511``). Safe to call multiple times."""
+    global _world
+    with _lock:
+        if _world is None:
+            return
+        if _world.coord is not None:
+            _world.coord.shutdown()
+        if _world.timeline is not None:
+            _world.timeline.close()
+        _world = None
+        # Drop compiled eager-collective executables from the dead world —
+        # their cache keys (generation) can never hit again.
+        from .ops import collectives as _c
+        _c._eager_fn.cache_clear()
+
+
+def is_initialized() -> bool:
+    return _world is not None
+
+
+def world() -> World:
+    if _world is None:
+        raise NotInitializedError()
+    return _world
+
+
+def mesh() -> Mesh:
+    """The world mesh. Collectives reduce over its ``"hvd"`` axis."""
+    return world().mesh
+
+
+def size() -> int:
+    """World size = number of chips (parity: ``horovod_tensorflow_size``,
+    ``mpi_ops.cc:1560-1566``)."""
+    return world().size
+
+
+def _in_world_trace() -> bool:
+    """True when called under a trace with the ``hvd`` axis bound
+    (i.e. inside ``shard_map`` over the world mesh)."""
+    try:
+        jax.lax.axis_index(AXIS)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def rank():
+    """This rank's index in [0, size).
+
+    Inside compiled code over the world mesh → per-chip ``lax.axis_index``
+    (a traced value). Outside → the controller's first local chip index
+    (parity: ``horovod_tensorflow_rank``, ``mpi_ops.cc:1546-1552``).
+    """
+    w = world()
+    if _in_world_trace():
+        return jax.lax.axis_index(AXIS)
+    return w.controller_rank
+
+
+def local_rank() -> int:
+    """Index of this chip among chips on the same host (parity:
+    ``horovod_tensorflow_local_rank``, ``mpi_ops.cc:1553-1559``)."""
+    return world().local_rank
+
+
+def process_index() -> int:
+    return world().process_index
+
+
+def process_count() -> int:
+    return world().process_count
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers used across the framework.
+# ---------------------------------------------------------------------------
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(mesh(), P())
+
+
+def ranked_sharding() -> NamedSharding:
+    """Leading axis split one-slice-per-rank over the world axis."""
+    return NamedSharding(mesh(), P(AXIS))

@@ -1,0 +1,1 @@
+"""Utilities: config (env knobs), timeline (Chrome tracing), validation."""

@@ -1,0 +1,23 @@
+"""JAX version compatibility shims."""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def _resolve_all_gather_invariant():
+    """``all_gather`` whose output is marked replicated (invariant) over the
+    axis, so ``shard_map(..., out_specs=P())`` type-checks under VMA
+    analysis. Public in newer JAX; fall back to the private symbol, then to
+    plain ``all_gather`` (pre-VMA versions don't need the distinction)."""
+    fn = getattr(lax, "all_gather_invariant", None)
+    if fn is not None:
+        return fn
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+        return all_gather_invariant
+    except ImportError:
+        return lax.all_gather
+
+
+all_gather_invariant = _resolve_all_gather_invariant()

@@ -1,0 +1,105 @@
+"""Environment-variable configuration.
+
+The reference has no config files or CLI flags — everything is plain env vars
+(SURVEY §5.6): ``HOROVOD_TIMELINE`` (``mpi_ops.cc:1275``),
+``HOROVOD_FUSION_THRESHOLD`` (``mpi_ops.cc:1281-1284``; 0 disables fusion,
+``docs/tensor-fusion.md:24-28``), plus launcher-provided rank/size env vars that
+the tests read (``mpi_ops_test.py:31-63`` reads ``PMI_RANK``/
+``OMPI_COMM_WORLD_RANK`` etc.).
+
+We keep the same names where semantics match, and add ``HVD_*`` launcher vars
+(set by ``tpurun``) playing the role of the MPI launcher's env.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Default tensor-fusion threshold: 64 MiB (mpi_ops.cc:165).
+DEFAULT_FUSION_THRESHOLD: int = 64 * 1024 * 1024
+
+# Coordinator stall-warning threshold: 60 s (STALL_WARNING_TIME, mpi_ops.cc:228).
+DEFAULT_STALL_WARNING_SECS: float = 60.0
+
+# Background tick period: 5 ms (mpi_ops.cc:1295). Our host coordination core
+# uses the same default tick.
+DEFAULT_TICK_SECS: float = 0.005
+
+
+def _int_env(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def fusion_threshold_bytes() -> int:
+    """``HOROVOD_FUSION_THRESHOLD`` override (mpi_ops.cc:1281-1284).
+
+    0 disables fusion entirely (docs/tensor-fusion.md:24-28).
+    """
+    return _int_env("HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD)
+
+
+def timeline_path() -> str | None:
+    """``HOROVOD_TIMELINE`` — path for the Chrome-tracing file (mpi_ops.cc:1275).
+
+    Written by the coordinator (rank 0) only (docs/timeline.md:7-11).
+    """
+    return os.environ.get("HOROVOD_TIMELINE") or None
+
+
+def stall_warning_secs() -> float:
+    raw = os.environ.get("HOROVOD_STALL_CHECK_TIME")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_STALL_WARNING_SECS
+
+
+# ---------------------------------------------------------------------------
+# Launcher-provided process env (tpurun equivalent of mpirun's PMI/OMPI vars).
+# Tests mirror the reference's pattern of reading launcher env with defaults
+# (0, 1) when not launched distributed (mpi_ops_test.py:31-63).
+# ---------------------------------------------------------------------------
+
+_RANK_VARS = ("HVD_RANK", "PMI_RANK", "OMPI_COMM_WORLD_RANK")
+_SIZE_VARS = ("HVD_SIZE", "PMI_SIZE", "OMPI_COMM_WORLD_SIZE")
+_LOCAL_RANK_VARS = ("HVD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_RANK")
+
+
+def _first_env(names, default: int) -> int:
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None and v != "":
+            try:
+                return int(v)
+            except ValueError:
+                continue
+    return default
+
+
+def launcher_rank(default: int = 0) -> int:
+    return _first_env(_RANK_VARS, default)
+
+
+def launcher_size(default: int = 1) -> int:
+    return _first_env(_SIZE_VARS, default)
+
+
+def launcher_local_rank(default: int = 0) -> int:
+    return _first_env(_LOCAL_RANK_VARS, default)
+
+
+def coordinator_address() -> str | None:
+    """Rendezvous address for the multi-process control plane (DCN/TCP).
+
+    Plays the role MPI's out-of-band wire-up plays for the reference
+    (``MPI_Init``, ``mpi_ops.cc:1251``).
+    """
+    return os.environ.get("HVD_COORD_ADDR") or None

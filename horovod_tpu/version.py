@@ -1,0 +1,7 @@
+"""Package version.
+
+Reference parity: ``horovod/__init__.py:1`` (``__version__ = '0.11.2'``).
+This framework re-implements that capability surface TPU-natively.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Test harness: an 8-device virtual CPU mesh plays the role of
+``mpirun -np N`` on localhost (reference CI: ``.travis.yml:91`` runs
+``mpirun -np 2 python mpi_ops_test.py`` CPU-only; SURVEY §4 implication).
+
+Must run before any jax backend initialization: forces the CPU platform with
+8 virtual devices so the world mesh has 8 "ranks" without TPU hardware.
+"""
+
+import os
+
+_flag = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+    os.environ["XLA_FLAGS"] = (_existing + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# The reference's collectives cover 9 dtypes incl. float64/int64
+# (mpi_ops.cc:476-510); enable x64 so the sweeps exercise them.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _world():
+    hvd.init()
+    yield
+    hvd.shutdown()

@@ -1,0 +1,282 @@
+"""Collective correctness by algebraic identity (reference test model:
+``horovod/tensorflow/mpi_ops_test.py`` — expected values derived from
+rank/size, dtype×dim product sweeps, fused variants, per-root broadcast;
+SURVEY §4)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+
+DTYPES = [jnp.float32, jnp.float64, jnp.int32, jnp.int64]  # mpi_ops_test.py:92
+DIMS = [1, 2, 3]
+
+
+def _world_step(fn):
+    """shard_map a per-rank function over the world mesh (the compiled
+    context every in-trace collective runs in)."""
+    return jax.jit(jax.shard_map(
+        fn, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P()))
+
+
+def _stacked(x_np):
+    """Per-rank stacked input: leading dim == size, one slice per rank."""
+    return jax.device_put(x_np, NamedSharding(hvd.mesh(), P("hvd")))
+
+
+# ---------------------------------------------------------------------------
+# Allreduce: sum of per-rank tensors == sum of slices (mpi_ops_test.py:85-114
+# checks allreduce(seeded random) == tensor * size; with distinct per-rank
+# values the identity generalizes to the exact slice sum).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,dim", list(itertools.product(DTYPES, DIMS)))
+def test_allreduce_in_trace(dtype, dim):
+    size = hvd.size()
+    shape = (size,) + (4,) * dim
+    rng = np.random.RandomState(1234)
+    x = rng.randint(-10, 10, size=shape).astype(dtype)
+
+    out = _world_step(lambda t: hvd.allreduce(t[0], average=False))(
+        _stacked(x))
+    np.testing.assert_allclose(np.asarray(out), x.sum(axis=0), rtol=1e-6)
+
+
+def test_allreduce_average_in_trace():
+    size = hvd.size()
+    x = np.arange(size * 8, dtype=np.float32).reshape(size, 8)
+    out = _world_step(lambda t: hvd.allreduce(t[0], average=True))(
+        _stacked(x))
+    np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_eager_per_rank(dtype):
+    size = hvd.size()
+    x = np.arange(size * 6).reshape(size, 6).astype(dtype)
+    out = hvd.allreduce(_stacked(x), average=False)
+    np.testing.assert_allclose(np.asarray(out), x.sum(axis=0), rtol=1e-6)
+
+
+def test_allreduce_eager_replicated():
+    # Every rank contributes the same tensor → sum == tensor * size
+    # (exactly the mpi_ops_test.py:85-114 identity).
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = hvd.allreduce(x, average=False)
+    np.testing.assert_allclose(np.asarray(out), x * hvd.size(), rtol=1e-6)
+    out = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6)
+
+
+def test_allreduce_extra_ops():
+    size = hvd.size()
+    x = np.arange(size, dtype=np.float32).reshape(size, 1)
+    mx = _world_step(lambda t: hvd.allreduce(t[0], op=hvd.Op.MAX))(_stacked(x))
+    mn = _world_step(lambda t: hvd.allreduce(t[0], op=hvd.Op.MIN))(_stacked(x))
+    assert float(mx[0]) == size - 1
+    assert float(mn[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Fused variants: many allreduces in flight at once get bucketed
+# (mpi_ops_test.py:116-148 builds all ops before one session.run).
+# ---------------------------------------------------------------------------
+
+def test_allreduce_fused_many_tensors():
+    size = hvd.size()
+    rng = np.random.RandomState(7)
+    tensors = [rng.randn(size, 5, 3).astype(np.float32) for _ in range(17)]
+
+    def step(*ts):
+        return hvd.grouped_allreduce([t[0] for t in ts], average=False)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(),
+        in_specs=tuple(P("hvd") for _ in tensors),
+        out_specs=P()))
+    outs = fn(*[_stacked(t) for t in tensors])
+    for out, t in zip(outs, tensors):
+        np.testing.assert_allclose(np.asarray(out), t.sum(axis=0), rtol=1e-5)
+
+
+def test_allreduce_fused_mixed_dtype_preserves_values():
+    size = hvd.size()
+    a = np.ones((size, 4), np.float32)
+    b = (2 * np.ones((size, 4))).astype(np.int32)
+    c = (3 * np.ones((size, 4))).astype(np.float32)
+
+    def step(ta, tb, tc):
+        return hvd.grouped_allreduce([ta[0], tb[0], tc[0]], average=False)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P("hvd"),) * 3, out_specs=P()))
+    ra, rb, rc = fn(_stacked(a), _stacked(b), _stacked(c))
+    np.testing.assert_array_equal(np.asarray(ra), a.sum(axis=0))
+    np.testing.assert_array_equal(np.asarray(rb), b.sum(axis=0))
+    np.testing.assert_array_equal(np.asarray(rc), c.sum(axis=0))
+    assert rb.dtype == jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# Allgather: output = per-rank blocks in rank order (mpi_ops_test.py:358-394
+# gathers per-rank constant blocks and checks slice-by-slice).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype,dim", list(itertools.product(DTYPES, DIMS)))
+def test_allgather_in_trace(dtype, dim):
+    size = hvd.size()
+    block = (2,) + (3,) * (dim - 1) if dim > 1 else (2,)
+    x = np.stack([np.full(block, r) for r in range(size)]).astype(dtype)
+
+    out = _world_step(lambda t: hvd.allgather(t[0]))(_stacked(x))
+    out = np.asarray(out)
+    assert out.shape == (size * block[0],) + block[1:]
+    for r in range(size):
+        np.testing.assert_array_equal(
+            out[r * block[0]:(r + 1) * block[0]], np.full(block, r))
+
+
+def test_allgather_eager_per_rank():
+    size = hvd.size()
+    x = np.stack([np.full((2, 3), r, np.float32) for r in range(size)])
+    out = np.asarray(hvd.allgather(_stacked(x)))
+    assert out.shape == (2 * size, 3)
+    for r in range(size):
+        np.testing.assert_array_equal(out[2 * r:2 * r + 2], x[r])
+
+
+def test_allgather_ragged_in_trace():
+    """Variable first dims per rank (mpi_ops_test.py:396-442) under XLA
+    static shapes: pad-to-max + negotiated sizes vector."""
+    size = hvd.size()
+    max_rows = size + 1
+    # rank r contributes r+1 rows of value r
+    x = np.zeros((size, max_rows, 2), np.float32)
+    for r in range(size):
+        x[r, :r + 1, :] = r
+
+    def step(t):
+        valid = jax.lax.axis_index("hvd") + 1
+        return hvd.allgather_ragged(t[0], valid, max_rows)
+
+    gathered, sizes = _world_step(step)(_stacked(x))
+    gathered, sizes = np.asarray(gathered), np.asarray(sizes)
+    np.testing.assert_array_equal(sizes, np.arange(1, size + 1))
+    for r in range(size):
+        block = gathered[r * max_rows:(r + 1) * max_rows]
+        np.testing.assert_array_equal(block[:r + 1], np.full((r + 1, 2), r))
+        np.testing.assert_array_equal(block[r + 1:],
+                                      np.zeros((max_rows - r - 1, 2)))
+
+
+# ---------------------------------------------------------------------------
+# Broadcast: result equals the root's tensor for every root rank
+# (mpi_ops_test.py:480-512).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", DTYPES + [jnp.bool_])
+def test_broadcast_in_trace_all_roots(dtype):
+    size = hvd.size()
+    if dtype == jnp.bool_:
+        x = np.stack([np.full((3, 2), r % 2, bool) for r in range(size)])
+    else:
+        x = np.stack([np.full((3, 2), r) for r in range(size)]).astype(dtype)
+    for root in range(size):
+        out = _world_step(lambda t: hvd.broadcast(t[0], root_rank=root))(
+            _stacked(x))
+        np.testing.assert_array_equal(np.asarray(out), x[root])
+        assert out.dtype == x.dtype
+
+
+def test_broadcast_eager_per_rank():
+    size = hvd.size()
+    x = np.stack([np.full((4,), r, np.float32) for r in range(size)])
+    for root in (0, size - 1):
+        out = hvd.broadcast(_stacked(x), root_rank=root)
+        np.testing.assert_array_equal(np.asarray(out), x[root])
+
+
+# ---------------------------------------------------------------------------
+# rank()/size() in both contexts (mpi_ops_test.py reads launcher env;
+# ours derive from the mesh).
+# ---------------------------------------------------------------------------
+
+def test_rank_and_size():
+    assert hvd.size() == len(jax.devices())
+    assert hvd.local_rank() == 0
+    assert hvd.rank() == 0  # controller rank outside compiled code
+
+    ranks = np.asarray(_world_step(
+        lambda t: hvd.allgather(jnp.reshape(hvd.rank(), (1,)) + 0 * t[0][:1, 0]))(
+        _stacked(np.zeros((hvd.size(), 2, 2), np.float32))))
+    np.testing.assert_array_equal(ranks, np.arange(hvd.size()))
+
+
+def test_not_initialized_error():
+    import horovod_tpu.runtime as rt
+    saved = rt._world
+    rt._world = None
+    try:
+        with pytest.raises(hvd.NotInitializedError):
+            hvd.size()
+    finally:
+        rt._world = saved
+
+
+# ---------------------------------------------------------------------------
+# TPU-era extras.
+# ---------------------------------------------------------------------------
+
+def test_alltoall_in_trace():
+    size = hvd.size()
+    # rank r sends block (r, c) to rank c; after all_to_all, rank r holds
+    # blocks (c, r) for all c.
+    x = np.arange(size * size, dtype=np.float32).reshape(size, size, 1)
+
+    def step(t):
+        local = t[0]  # [size, 1] — row r of the matrix
+        return hvd.allgather(hvd.alltoall(local))
+
+    out = np.asarray(_world_step(step)(_stacked(x)))
+    # rank r's post-alltoall block is column r → gathered = x.T flattened
+    np.testing.assert_array_equal(
+        out.reshape(size, size), x.reshape(size, size).T)
+
+
+def test_reducescatter_in_trace():
+    size = hvd.size()
+    x = np.stack([np.arange(size * 2, dtype=np.float32) + r
+                  for r in range(size)])
+
+    def step(t):
+        return hvd.allgather(hvd.reducescatter(t[0]))
+
+    out = np.asarray(_world_step(step)(_stacked(x)))
+    np.testing.assert_allclose(out, x.sum(axis=0))
+
+
+def test_broadcast_repairs_nan_on_nonroot_ranks():
+    """Broadcast must deliver the root's values even when non-root ranks
+    hold NaN/Inf — re-syncing diverged replicas is its main job (§5.4)."""
+    size = hvd.size()
+    x = np.stack([np.full((3,), 1.0 if r == 0 else np.nan, np.float32)
+                  for r in range(size)])
+    out = _world_step(lambda t: hvd.broadcast(t[0], root_rank=0))(_stacked(x))
+    np.testing.assert_array_equal(np.asarray(out), np.ones((3,)))
+
+
+def test_broadcast_root_rank_out_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        hvd.broadcast(np.ones(3), root_rank=hvd.size())
+
+
+def test_sparse_allreduce_rejects_unsupported_op():
+    from horovod_tpu.ops.sparse import IndexedSlices
+    s = IndexedSlices(jnp.ones((1, 2)), jnp.zeros((1,), jnp.int32), (4, 2))
+    with pytest.raises(ValueError, match="not supported for sparse"):
+        hvd.allreduce(s, op=hvd.Op.MAX)

@@ -1,0 +1,49 @@
+"""Tensor-fusion bucketing semantics (reference: fusion decision
+``mpi_ops.cc:1395-1422``; ``docs/tensor-fusion.md:6-28``)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.fusion import plan_buckets
+
+
+def _leaf(n, dtype=jnp.float32):
+    return jnp.zeros((n,), dtype)
+
+
+def test_same_dtype_fuses_under_threshold():
+    leaves = [_leaf(10), _leaf(20), _leaf(30)]
+    assert plan_buckets(leaves, fusion_threshold=1 << 20) == [[0, 1, 2]]
+
+
+def test_threshold_caps_bucket_bytes():
+    # 3 × 100 float32 = 1200 B; cap at 800 B → [0,1] then [2]
+    leaves = [_leaf(100), _leaf(100), _leaf(100)]
+    assert plan_buckets(leaves, fusion_threshold=800) == [[0, 1], [2]]
+
+
+def test_dtype_change_closes_bucket_preserving_order():
+    # Reference rule: stop at the first non-fusable tensor; never reorder
+    # (mpi_ops.cc:1414-1419). f32,f32,i32,f32 → [0,1],[2],[3] — the trailing
+    # f32 does NOT join the first bucket.
+    leaves = [_leaf(8), _leaf(8), _leaf(8, jnp.int32), _leaf(8)]
+    assert plan_buckets(leaves, fusion_threshold=1 << 20) == [[0, 1], [2], [3]]
+
+
+def test_zero_threshold_disables_fusion():
+    # HOROVOD_FUSION_THRESHOLD=0 disables fusion (docs/tensor-fusion.md:24-28).
+    leaves = [_leaf(8), _leaf(8)]
+    assert plan_buckets(leaves, fusion_threshold=0) == [[0], [1]]
+
+
+def test_oversized_tensor_gets_own_bucket():
+    leaves = [_leaf(4), _leaf(10_000), _leaf(4)]
+    assert plan_buckets(leaves, fusion_threshold=64) == [[0], [1], [2]]
+
+
+def test_env_default_is_64mib(monkeypatch):
+    monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+    from horovod_tpu.utils import config
+    assert config.fusion_threshold_bytes() == 64 * 1024 * 1024
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    assert config.fusion_threshold_bytes() == 1024

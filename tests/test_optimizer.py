@@ -1,0 +1,168 @@
+"""DistributedOptimizer + broadcast-variables semantics
+(reference: ``horovod/tensorflow/__init__.py:82-226``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.sparse import IndexedSlices
+
+
+def _stacked(x_np):
+    return jax.device_put(x_np, NamedSharding(hvd.mesh(), P("hvd")))
+
+
+def test_distributed_optimizer_averages_gradients():
+    """Each rank computes a different gradient; after one update every rank
+    must hold identical params equal to the update with the mean gradient
+    (the DistributedOptimizer contract, __init__.py:164-186)."""
+    size = hvd.size()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones((4,), jnp.float32)}
+
+    per_rank_grads = np.stack(
+        [np.full((4,), float(r), np.float32) for r in range(size)])
+
+    def step(g):
+        grads = {"w": g[0]}
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P()))(
+        _stacked(per_rank_grads))
+
+    mean_grad = per_rank_grads.mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), 1.0 - 0.1 * mean_grad, rtol=1e-6)
+
+
+def test_distributed_optimizer_state_is_inner_state():
+    """Checkpoint compatibility: wrapped state == inner optax state (the
+    analog of the Keras dynamic-subclass trick, keras/__init__.py:81-87)."""
+    inner = optax.adam(1e-3)
+    wrapped = hvd.DistributedOptimizer(inner)
+    params = {"w": jnp.ones((3,))}
+    s_inner = inner.init(params)
+    s_wrapped = wrapped.init(params)
+    assert jax.tree_util.tree_structure(s_inner) == \
+        jax.tree_util.tree_structure(s_wrapped)
+
+
+def test_broadcast_global_variables():
+    size = hvd.size()
+    # Per-rank divergent params: rank r has w=r. After broadcast from root 0,
+    # every rank holds root's values (§5.4 consistency protocol).
+    per_rank = np.stack([np.full((2,), float(r), np.float32)
+                         for r in range(size)])
+
+    def step(w):
+        tree = {"w": w[0], "b": w[0] + 1}
+        return hvd.broadcast_global_variables(tree, root_rank=0)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P()))(
+        _stacked(per_rank))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.zeros((2,)))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.ones((2,)))
+
+
+def test_sparse_gradient_allreduce():
+    """IndexedSlices leaves take the two-allgather path
+    (__init__.py:61-72): gathered values/size + gathered indices."""
+    size = hvd.size()
+    vocab, dim = 10, 3
+    # rank r touches rows [r, r+1] with gradient value (r+1)
+    values = np.stack([np.full((2, dim), float(r + 1), np.float32)
+                       for r in range(size)])
+    indices = np.stack([np.array([r, r + 1], np.int32) for r in range(size)])
+
+    def step(v, i):
+        g = IndexedSlices(v[0], i[0], (vocab, dim))
+        out = hvd.allreduce(g, average=True)
+        return out.to_dense()
+
+    dense = np.asarray(jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P("hvd"), P("hvd")), out_specs=P()))(
+        _stacked(values), _stacked(indices)))
+
+    expected = np.zeros((vocab, dim), np.float32)
+    for r in range(size):
+        expected[r] += (r + 1) / size
+        expected[r + 1] += (r + 1) / size
+    np.testing.assert_allclose(dense, expected, rtol=1e-6)
+
+
+def test_allreduce_gradients_mixed_dense_sparse():
+    size = hvd.size()
+    dense_g = np.stack([np.full((4,), float(r), np.float32)
+                        for r in range(size)])
+    sp_vals = np.stack([np.ones((1, 2), np.float32) for _ in range(size)])
+    sp_idx = np.stack([np.array([r % 3], np.int32) for r in range(size)])
+
+    def step(d, v, i):
+        grads = {"dense": d[0],
+                 "emb": IndexedSlices(v[0], i[0], (3, 2))}
+        out = hvd.allreduce_gradients(grads, average=True)
+        return {"dense": out["dense"], "emb": out["emb"].to_dense()}
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P("hvd"),) * 3, out_specs=P()))(
+        _stacked(dense_g), _stacked(sp_vals), _stacked(sp_idx))
+
+    np.testing.assert_allclose(np.asarray(out["dense"]),
+                               dense_g.mean(axis=0), rtol=1e-6)
+    expected = np.zeros((3, 2), np.float32)
+    for r in range(size):
+        expected[r % 3] += 1.0 / size
+    np.testing.assert_allclose(np.asarray(out["emb"]), expected, rtol=1e-6)
+
+
+def test_sparse_as_dense():
+    size = hvd.size()
+    sp_vals = np.stack([np.ones((1, 2), np.float32) for _ in range(size)])
+    sp_idx = np.stack([np.array([0], np.int32) for _ in range(size)])
+
+    def step(v, i):
+        grads = {"emb": IndexedSlices(v[0], i[0], (2, 2))}
+        out = hvd.allreduce_gradients(grads, average=False,
+                                      sparse_as_dense=True)
+        return out
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P("hvd"),) * 2, out_specs=P()))(
+        _stacked(sp_vals), _stacked(sp_idx))
+    assert isinstance(out["emb"], jax.Array)  # densified
+    expected = np.zeros((2, 2), np.float32)
+    expected[0] = size
+    np.testing.assert_array_equal(np.asarray(out["emb"]), expected)
+
+
+def test_grouped_allreduce_keeps_indexed_slices_whole():
+    """A sparse leaf inside grouped_allreduce must take the allgather path —
+    its integer indices must never be summed as dense data."""
+    size = hvd.size()
+    sp_vals = np.stack([np.ones((1, 2), np.float32) for _ in range(size)])
+    sp_idx = np.stack([np.array([r % 3], np.int32) for r in range(size)])
+    dense_g = np.stack([np.full((4,), 1.0, np.float32) for _ in range(size)])
+
+    def step(d, v, i):
+        out = hvd.grouped_allreduce(
+            {"w": d[0], "emb": IndexedSlices(v[0], i[0], (3, 2))},
+            average=False)
+        assert isinstance(out["emb"], IndexedSlices)
+        return {"w": out["w"], "emb": out["emb"].to_dense()}
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P("hvd"),) * 3, out_specs=P()))(
+        _stacked(dense_g), _stacked(sp_vals), _stacked(sp_idx))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4,), size))
+    expected = np.zeros((3, 2), np.float32)
+    for r in range(size):
+        expected[r % 3] += 1.0
+    np.testing.assert_array_equal(np.asarray(out["emb"]), expected)
