@@ -1,0 +1,108 @@
+"""Benchmark: ResNet-50 synthetic training throughput (images/sec/chip).
+
+Mirrors the reference's benchmark methodology — `tf_cnn_benchmarks.py
+--variable_update horovod` with synthetic data (``docs/benchmarks.md:8-98``)
+— on the flagship north-star workload (ResNet-50,
+``examples/keras_imagenet_resnet50.py``). The baseline for ``vs_baseline``
+is the reference's only published absolute throughput: ResNet-101 at
+1656.82 images/sec across 16 Pascal GPUs = 103.55 images/sec/GPU
+(``docs/benchmarks.md:24-54``; see /root/repo/BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import models, training
+
+# Reference baseline: 1656.82 images/sec on 16 GPUs (docs/benchmarks.md:24-54).
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16
+
+
+def main() -> None:
+    smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
+    on_tpu = jax.default_backend() == "tpu"
+
+    if smoke or not on_tpu:
+        image, batch_per_chip, warmup, iters = 64, 16, 2, 5
+        depth_cfg = dict(model="cifar20")
+    else:
+        image, batch_per_chip, warmup, iters = 224, 128, 5, 20
+        depth_cfg = dict(model="resnet50")
+
+    hvd.init()
+    n = hvd.size()
+    batch = batch_per_chip * n
+
+    if depth_cfg["model"] == "resnet50":
+        model = models.resnet50(num_classes=1000, dtype=jnp.bfloat16,
+                                axis_name=hvd.AXIS)
+        classes = 1000
+    else:
+        model = models.cifar_resnet_v1(20, dtype=jnp.float32,
+                                       axis_name=hvd.AXIS)
+        classes = 10
+
+    x_shape = (batch, image, image, 3)
+    # Init from a per-chip-sized sample: flax init runs a real forward pass
+    # on one device, so a global-batch sample would OOM at pod scale.
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0),
+        jnp.zeros((batch_per_chip,) + x_shape[1:], jnp.float32),
+        optax.sgd(0.1, momentum=0.9))
+    step = training.make_train_step(model, dist_opt)
+
+    # Materialize only local shards (a host-side global batch would be
+    # multiple GB at pod scale).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(hvd.mesh(), P(hvd.AXIS))
+
+    def _shard_data(idx):
+        rng = np.random.RandomState(hash(str(idx)) % 2**31)
+        shape = tuple(s.stop - s.start if s.start is not None else dim
+                      for s, dim in zip(idx, x_shape))
+        return rng.standard_normal(shape).astype(np.float32)
+
+    def _shard_labels(idx):
+        rng = np.random.RandomState(1 + hash(str(idx)) % 2**31)
+        n = idx[0].stop - idx[0].start if idx[0].start is not None else batch
+        return rng.randint(0, classes, size=(n,))
+
+    data = (
+        jax.make_array_from_callback(x_shape, sharding, _shard_data),
+        jax.make_array_from_callback((batch,), sharding, _shard_labels),
+    )
+
+    for _ in range(warmup):
+        state, metrics = step(state, data)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, data)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    per_chip = img_per_sec / n
+    print(json.dumps({
+        "metric": f"{depth_cfg['model']}_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

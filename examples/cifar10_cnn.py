@@ -1,0 +1,69 @@
+"""CIFAR-10 simple CNN — parity with ``examples/keras-cifar10-cnn.py``
+(reference): two conv blocks + dense head, LR scaled by world size.
+
+    python examples/cifar10_cnn.py --epochs 2
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+import common  # noqa: E402,F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, training, trainer as T
+
+from common import load_cifar10, batches
+
+
+class Cifar10CNN(nn.Module):
+    """The reference's 4-conv Keras CNN (keras-cifar10-cnn.py:36-59)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        for filters in (32, 32):
+            x = nn.Conv(filters, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        for filters in (64, 64):
+            x = nn.Conv(filters, (3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-per-chip", type=int, default=32)
+    args = p.parse_args()
+
+    hvd.init()
+    (x_train, y_train), (x_test, y_test) = load_cifar10()
+    global_batch = args.batch_per_chip * hvd.size()
+
+    model = Cifar10CNN()
+    opt = callbacks.hyper_sgd(0.01 * hvd.size(), momentum=0.9)
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt)
+    step = training.make_train_step(model, dist_opt)
+    eval_step = training.make_eval_step(model)
+
+    tr = T.Trainer(step, state, eval_step=eval_step)
+    tr.fit(batches(x_train, y_train, global_batch), epochs=args.epochs,
+           callbacks=[callbacks.BroadcastGlobalVariablesCallback(0),
+                      callbacks.MetricAverageCallback()],
+           eval_data=batches(x_test, y_test, global_batch, shuffle=False))
+
+
+if __name__ == "__main__":
+    main()

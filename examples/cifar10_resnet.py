@@ -1,0 +1,77 @@
+"""CIFAR-10 ResNet v1/v2 — parity with ``examples/keras-cifar10-resnet.py``
+(reference): selectable depth/version, the staged LR schedule
+(keras-cifar10-resnet.py lr_schedule: ×1 → ×1e-1 @80 → ×1e-2 @120 →
+×1e-3 @160 → ×0.5e-3 @180), tensor fusion of conv gradients.
+
+    python examples/cifar10_resnet.py --depth 20 --version 1 --epochs 2
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+import common  # noqa: E402,F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, models, training, trainer as T
+
+from common import load_cifar10, batches
+
+
+def lr_multiplier(epoch: int) -> float:
+    """The reference's staged schedule (keras-cifar10-resnet.py:75-95),
+    expressed as a multiplier of the base LR."""
+    if epoch >= 180:
+        return 0.5e-3
+    if epoch >= 160:
+        return 1e-3
+    if epoch >= 120:
+        return 1e-2
+    if epoch >= 80:
+        return 1e-1
+    return 1.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=20)
+    p.add_argument("--version", type=int, default=1, choices=(1, 2))
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-per-chip", type=int, default=32)
+    args = p.parse_args()
+
+    hvd.init()
+    (x_train, y_train), (x_test, y_test) = load_cifar10()
+    global_batch = args.batch_per_chip * hvd.size()
+    steps_per_epoch = len(x_train) // global_batch
+
+    make = (models.cifar_resnet_v1 if args.version == 1
+            else models.cifar_resnet_v2)
+    model = make(args.depth, dtype=jnp.bfloat16, axis_name=hvd.AXIS)
+
+    opt = callbacks.hyper_sgd(1e-1 * hvd.size(), momentum=0.9)
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 32, 32, 3)), opt)
+    step = training.make_train_step(model, dist_opt)
+    eval_step = training.make_eval_step(model)
+
+    tr = T.Trainer(step, state, eval_step=eval_step,
+                   steps_per_epoch=steps_per_epoch)
+    tr.fit(
+        batches(x_train, y_train, global_batch),
+        epochs=args.epochs,
+        callbacks=[
+            callbacks.BroadcastGlobalVariablesCallback(0),
+            callbacks.MetricAverageCallback(),
+            callbacks.LearningRateWarmupCallback(
+                warmup_epochs=min(5, args.epochs),
+                steps_per_epoch=steps_per_epoch),
+            callbacks.LearningRateScheduleCallback(
+                lr_multiplier, start_epoch=min(5, args.epochs)),
+        ],
+        eval_data=batches(x_test, y_test, global_batch, shuffle=False),
+    )
+
+
+if __name__ == "__main__":
+    main()

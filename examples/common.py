@@ -1,0 +1,67 @@
+"""Shared example utilities: dataset loading with synthetic fallback.
+
+The reference examples download MNIST/CIFAR via Keras; in a no-egress
+environment we load from a local directory when present
+(``HVD_DATA_DIR``) and otherwise generate a deterministic synthetic
+stand-in with the same shapes — the examples' structure (the part that
+demonstrates the framework) is unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Allow `python examples/<x>.py` from a raw checkout (no install step —
+# the reference requires `pip install horovod` first; we don't).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def _synthetic(n, shape, classes, seed):
+    rng = np.random.RandomState(seed)
+    # A learnable task: labels depend linearly on the input so loss
+    # actually decreases (pure noise would plateau instantly).
+    x = rng.randn(n, *shape).astype(np.float32)
+    w = rng.randn(int(np.prod(shape)), classes).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def load_mnist(n_train=4096, n_test=512):
+    d = os.environ.get("HVD_DATA_DIR")
+    if d and os.path.exists(os.path.join(d, "mnist.npz")):
+        with np.load(os.path.join(d, "mnist.npz")) as f:
+            return ((f["x_train"].reshape(-1, 784).astype(np.float32) / 255.0,
+                     f["y_train"].astype(np.int32)),
+                    (f["x_test"].reshape(-1, 784).astype(np.float32) / 255.0,
+                     f["y_test"].astype(np.int32)))
+    return (_synthetic(n_train, (784,), 10, 0),
+            _synthetic(n_test, (784,), 10, 1))
+
+
+def load_cifar10(n_train=4096, n_test=512):
+    d = os.environ.get("HVD_DATA_DIR")
+    if d and os.path.exists(os.path.join(d, "cifar10.npz")):
+        with np.load(os.path.join(d, "cifar10.npz")) as f:
+            return ((f["x_train"].astype(np.float32) / 255.0,
+                     f["y_train"].astype(np.int32).ravel()),
+                    (f["x_test"].astype(np.float32) / 255.0,
+                     f["y_test"].astype(np.int32).ravel()))
+    return (_synthetic(n_train, (32, 32, 3), 10, 0),
+            _synthetic(n_test, (32, 32, 3), 10, 1))
+
+
+def batches(x, y, global_batch, *, seed=0, shuffle=True):
+    """Zero-arg-callable factory over (x, y) host batches of ``global_batch``."""
+    def gen():
+        idx = np.arange(len(x))
+        if shuffle:
+            np.random.RandomState(seed).shuffle(idx)
+        for i in range(0, len(idx) - global_batch + 1, global_batch):
+            sel = idx[i:i + global_batch]
+            yield x[sel], y[sel]
+    return gen

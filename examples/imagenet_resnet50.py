@@ -1,0 +1,120 @@
+"""ImageNet ResNet-50 — the north-star workload. Parity with
+``examples/keras_imagenet_resnet50.py`` (reference): Goyal et al. recipe
+(batch 32/worker, base_lr·size, 5-epoch warmup, ×0.1 decay @ 30/60/80,
+weight decay), checkpoint-resume with the epoch broadcast from rank 0
+(keras_imagenet_resnet50.py:47-56), rank-0 checkpointing, allreduced
+final eval (keras_imagenet_resnet50.py:150).
+
+Without an ImageNet tree on disk this runs on synthetic data — structure
+and collectives are identical.
+
+    python examples/imagenet_resnet50.py --epochs 2 --image 64
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import common  # noqa: E402,F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, models, training, trainer as T
+
+from common import _synthetic, batches
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--warmup-epochs", type=int, default=1)
+    p.add_argument("--batch-per-chip", type=int, default=32)  # ref: 32/worker
+    p.add_argument("--base-lr", type=float, default=0.0125)   # ref: 0.0125
+    p.add_argument("--wd", type=float, default=5e-5)          # ref: 5e-5
+    p.add_argument("--image", type=int, default=64)
+    p.add_argument("--classes", type=int, default=100)
+    p.add_argument("--ckpt-dir", default="/tmp/hvd_resnet50_ckpt")
+    args = p.parse_args()
+
+    hvd.init()
+    verbose = hvd.rank() == 0  # rank-0 verbosity (keras_imagenet_resnet50.py:59)
+
+    global_batch = args.batch_per_chip * hvd.size()
+    x_train, y_train = _synthetic(
+        max(global_batch * 4, 256), (args.image, args.image, 3),
+        args.classes, 0)
+    steps_per_epoch = len(x_train) // global_batch
+
+    model = models.resnet50(num_classes=args.classes, dtype=jnp.bfloat16,
+                            axis_name=hvd.AXIS)
+    # lr = base_lr * size (keras_imagenet_resnet50.py:113); SGD momentum 0.9
+    # + weight decay 5e-5.
+    import optax
+    opt = optax.inject_hyperparams(
+        lambda learning_rate, momentum: optax.chain(
+            optax.add_decayed_weights(args.wd),
+            optax.sgd(learning_rate, momentum=momentum)),
+    )(learning_rate=args.base_lr * hvd.size(), momentum=0.9)
+
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0),
+        jnp.zeros((2, args.image, args.image, 3)), opt)
+    step = training.make_train_step(model, dist_opt)
+    eval_step = training.make_eval_step(model)
+
+    # Checkpoint-resume: rank 0 scans for the latest checkpoint and the
+    # epoch number is broadcast so every rank resumes in lockstep
+    # (keras_imagenet_resnet50.py:47-56).
+    resume_step = T.latest_checkpoint_step(args.ckpt_dir) or 0
+    resume_step = int(hvd.broadcast(jnp.asarray(resume_step), root_rank=0,
+                                    name="resume_epoch"))
+    initial_epoch = resume_step // max(steps_per_epoch, 1)
+    if resume_step:
+        state = T.restore_checkpoint(args.ckpt_dir, state)
+        if verbose:
+            print(f"resumed from step {resume_step} (epoch {initial_epoch})")
+
+    tr = T.Trainer(step, state, eval_step=eval_step,
+                   steps_per_epoch=steps_per_epoch, verbose=verbose)
+
+    class CheckpointCallback(callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            T.save_checkpoint(args.ckpt_dir, self.trainer.state)  # rank-0 only
+
+    # Staged decay ×0.1 @ 30/60/80 (keras_imagenet_resnet50.py:118-122).
+    def decay(epoch):
+        if epoch >= 80:
+            return 1e-3
+        if epoch >= 60:
+            return 1e-2
+        if epoch >= 30:
+            return 1e-1
+        return 1.0
+
+    tr.fit(
+        batches(x_train, y_train, global_batch),
+        epochs=args.epochs,
+        initial_epoch=initial_epoch,
+        callbacks=[
+            callbacks.BroadcastGlobalVariablesCallback(0),
+            callbacks.MetricAverageCallback(),
+            callbacks.LearningRateWarmupCallback(
+                warmup_epochs=args.warmup_epochs,
+                steps_per_epoch=steps_per_epoch, verbose=int(verbose)),
+            callbacks.LearningRateScheduleCallback(
+                decay, start_epoch=args.warmup_epochs),
+            CheckpointCallback(),
+        ],
+    )
+
+    # Allreduced final eval (keras_imagenet_resnet50.py:150).
+    ev = eval_step(tr.state, training.shard_batch(
+        (jnp.asarray(x_train[:global_batch]),
+         jnp.asarray(y_train[:global_batch]))))
+    score = hvd.allreduce(ev["accuracy"], name="final_eval")
+    if verbose:
+        print("final eval accuracy (allreduced):", float(score))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""MNIST with a raw training loop — parity with
+``examples/tensorflow_mnist.py`` (reference): init → scale LR by size →
+wrap optimizer in DistributedOptimizer → broadcast initial state →
+rank-0-only checkpointing.
+
+Run single-controller (all local chips form the world):
+    python examples/mnist.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import common  # noqa: E402,F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import models, training, trainer as T
+from horovod_tpu.callbacks import hyper_sgd
+
+from common import load_mnist, batches
+
+
+def main():
+    # 1. Initialize the world (tensorflow_mnist.py:69 `hvd.init()`).
+    hvd.init()
+
+    (x_train, y_train), (x_test, y_test) = load_mnist()
+    global_batch = 64 * hvd.size() // hvd.size() * hvd.size()  # divisible
+
+    model = models.MnistCNN()
+    # 2. Scale LR by world size (tensorflow_mnist.py:78 `0.001 * hvd.size()`).
+    opt = hyper_sgd(0.05 * hvd.size(), momentum=0.9)
+    # 3. DistributedOptimizer: fused gradient allreduce (tensorflow_mnist.py:81).
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 784)), opt)
+    step = training.make_train_step(model, dist_opt,
+                                    metrics_fn=lambda lg, lb: {
+                                        "accuracy": training.accuracy(lg, lb)})
+    eval_step = training.make_eval_step(model)
+
+    # 4. Broadcast initial state from rank 0 (BroadcastGlobalVariablesHook,
+    #    tensorflow_mnist.py:87-90).
+    state = hvd.broadcast_parameters(state, root_rank=0)
+
+    tr = T.Trainer(step, state, eval_step=eval_step)
+    tr.fit(batches(x_train, y_train, global_batch), epochs=2,
+           eval_data=batches(x_test, y_test, global_batch, shuffle=False))
+
+    # 5. Rank-0-only checkpoint (tensorflow_mnist.py:106-108 checkpoint_dir).
+    path = T.save_checkpoint("/tmp/hvd_mnist_ckpt", tr.state)
+    if path:
+        print("checkpoint:", path)
+
+
+if __name__ == "__main__":
+    main()

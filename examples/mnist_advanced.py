@@ -1,0 +1,53 @@
+"""MNIST with the full callback stack — parity with
+``examples/keras_mnist_advanced.py`` (reference): gradual LR warmup,
+metric averaging across ranks, broadcast at train start, rank-0 verbosity.
+
+    python examples/mnist_advanced.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+import common  # noqa: E402,F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, models, training, trainer as T
+
+from common import load_mnist, batches
+
+
+def main():
+    hvd.init()
+    (x_train, y_train), (x_test, y_test) = load_mnist()
+    global_batch = 64 * hvd.size()
+    epochs = 4
+    steps_per_epoch = len(x_train) // global_batch
+
+    model = models.MnistCNN()
+    # Scale LR by size; warmup brings it up gradually (keras_mnist_advanced.py
+    # lr=1.0*size + LearningRateWarmupCallback).
+    opt = callbacks.hyper_sgd(0.05 * hvd.size(), momentum=0.9)
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 784)), opt)
+    step = training.make_train_step(model, dist_opt)
+    eval_step = training.make_eval_step(model)
+
+    tr = T.Trainer(step, state, eval_step=eval_step,
+                   steps_per_epoch=steps_per_epoch)
+    tr.fit(
+        batches(x_train, y_train, global_batch),
+        epochs=epochs,
+        callbacks=[
+            # Broadcast initial state (keras_mnist_advanced.py:73-76).
+            callbacks.BroadcastGlobalVariablesCallback(0),
+            # Average metrics across ranks (keras_mnist_advanced.py:87-91).
+            callbacks.MetricAverageCallback(),
+            # Warmup lr/size -> lr over 3 epochs (keras_mnist_advanced.py:93).
+            callbacks.LearningRateWarmupCallback(
+                warmup_epochs=3, steps_per_epoch=steps_per_epoch, verbose=1),
+        ],
+        eval_data=batches(x_test, y_test, global_batch, shuffle=False),
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,15 @@ from .optimizer import (  # noqa: F401
     broadcast_parameters,
     broadcast_optimizer_state,
 )
+from . import callbacks  # noqa: F401
+from . import models  # noqa: F401
+from . import training  # noqa: F401
+from .trainer import (  # noqa: F401
+    Trainer,
+    save_checkpoint,
+    restore_checkpoint,
+    latest_checkpoint_step,
+)
 from .exceptions import (  # noqa: F401
     HorovodError,
     NotInitializedError,

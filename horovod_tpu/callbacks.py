@@ -1,0 +1,228 @@
+"""Training callbacks — parity with ``horovod/keras/callbacks.py``.
+
+* :class:`BroadcastGlobalVariablesCallback` — sync all state from rank 0 at
+  train begin (``callbacks.py:8-34``).
+* :class:`MetricAverageCallback` — epoch-end allreduce of metrics so
+  LR-plateau/loggers see globally averaged values (``callbacks.py:37-87``).
+* :class:`LearningRateScheduleCallback` — epoch- or batch-granular LR
+  multiplier with **momentum correction** (``callbacks.py:90-199``): while a
+  batch runs with lr' = lr·m, momentum is scaled by ``new_lr/old_lr`` and
+  restored at batch end (Goyal et al. 1706.02677, §3 "momentum correction").
+* :class:`LearningRateWarmupCallback` — gradual warmup
+  ``lr/size → lr`` over ``warmup_epochs`` (``callbacks.py:202-259``).
+
+TPU-native design
+-----------------
+optax is functional, so "set the optimizer's lr" becomes: build the inner
+optimizer with ``optax.inject_hyperparams`` (so ``learning_rate`` /
+``momentum`` live in the optimizer *state*), and callbacks rewrite those
+state leaves between steps with ``optax.tree_utils.tree_set``. Because the
+values are state — not trace-time constants — adjusting them every batch does NOT
+retrigger XLA compilation, which is what makes per-batch smooth warmup viable
+under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from . import runtime
+from .ops.collectives import allreduce
+from .optimizer import broadcast_global_variables
+
+
+def hyper_sgd(learning_rate: float, momentum: float = 0.0,
+              nesterov: bool = False) -> optax.GradientTransformation:
+    """SGD with runtime-adjustable ``learning_rate``/``momentum`` state —
+    what the LR callbacks require (the analog of mutable
+    ``model.optimizer.lr`` in the reference's Keras layer)."""
+    return optax.inject_hyperparams(optax.sgd)(
+        learning_rate=learning_rate, momentum=momentum, nesterov=nesterov)
+
+
+def get_hyperparam(opt_state, name: str):
+    return float(optax.tree_utils.tree_get(opt_state, name))
+
+
+def set_hyperparam(opt_state, name: str, value):
+    return optax.tree_utils.tree_set(opt_state, **{name: jnp.asarray(value)})
+
+
+class Callback:
+    """Keras-shaped callback protocol (the reference's callbacks subclass
+    ``keras.callbacks.Callback``). ``trainer`` is any object with
+    ``.state`` (a :class:`~horovod_tpu.training.TrainState`) and
+    ``.steps_per_epoch``."""
+
+    trainer: Any = None
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self, logs: Optional[Dict] = None): ...
+    def on_train_end(self, logs: Optional[Dict] = None): ...
+    def on_epoch_begin(self, epoch: int, logs: Optional[Dict] = None): ...
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None): ...
+    def on_batch_begin(self, batch: int, logs: Optional[Dict] = None): ...
+    def on_batch_end(self, batch: int, logs: Optional[Dict] = None): ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast params/opt state/BN stats from ``root_rank`` at train begin
+    (parity: ``callbacks.py:8-34``; consistency protocol SURVEY §5.4).
+
+    Under a replicated single-controller mesh this is a logical no-op but is
+    kept as an explicit re-sync point: after a restore-on-rank-0, it makes
+    every rank bit-identical again.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        t = self.trainer
+        t.state = broadcast_global_variables(t.state, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metrics over ranks (parity: ``callbacks.py:37-87``).
+    Must precede callbacks that consume metrics (ReduceLROnPlateau-style),
+    as the reference documents."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float, np.floating, np.integer)) \
+                    or (hasattr(v, "shape") and getattr(v, "shape") == ()):
+                logs[k] = float(np.asarray(
+                    allreduce(jnp.asarray(v, jnp.float32), average=True,
+                              name=f"metric.{k}")))
+
+
+class LearningRateScheduleCallback(Callback):
+    """LR = ``initial_lr * multiplier(epoch)`` between ``start_epoch`` and
+    ``end_epoch`` (parity: ``callbacks.py:90-199``).
+
+    ``staircase=True`` adjusts once per epoch with integer epoch;
+    ``staircase=False`` adjusts every batch with fractional
+    ``epoch + batch/steps_per_epoch``. With ``momentum_correction``, while a
+    batch runs at an adjusted LR the momentum is scaled by ``new_lr/old_lr``
+    and restored after the batch.
+    """
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr: Optional[float] = None
+        self.restore_momentum: Optional[float] = None
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- state plumbing ----------------------------------------------------
+    def _get_lr(self) -> float:
+        return get_hyperparam(self.trainer.state.opt_state, "learning_rate")
+
+    def _set_lr(self, v: float):
+        self.trainer.state.opt_state = set_hyperparam(
+            self.trainer.state.opt_state, "learning_rate", v)
+
+    def _has_momentum(self) -> bool:
+        # tree_get returns None (not KeyError) when the key is absent.
+        return optax.tree_utils.tree_get(
+            self.trainer.state.opt_state, "momentum") is not None
+
+    # -- schedule ----------------------------------------------------------
+    def _adjust_learning_rate(self, epoch: float):
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        if self.momentum_correction and old_lr > 0 and self._has_momentum():
+            m = get_hyperparam(self.trainer.state.opt_state, "momentum")
+            self.restore_momentum = m
+            self.trainer.state.opt_state = set_hyperparam(
+                self.trainer.state.opt_state, "momentum",
+                m * new_lr / old_lr)
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum is not None:
+            self.trainer.state.opt_state = set_hyperparam(
+                self.trainer.state.opt_state, "momentum",
+                self.restore_momentum)
+            self.restore_momentum = None
+
+    # -- hooks -------------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = getattr(
+                self.trainer, "steps_per_epoch", None)
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "steps_per_epoch is required for staircase=False "
+                    "(smooth per-batch adjustment)")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch
+                or (self.end_epoch is not None
+                    and self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup ``lr/size → lr`` over ``warmup_epochs``
+    (parity: ``callbacks.py:202-259``; Goyal et al. 1706.02677)::
+
+        lr'(epoch) = lr/size * (epoch * (size-1)/warmup + 1)
+    """
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        def multiplier(epoch):
+            size = runtime.size()
+            # Shift so each epoch ends on a round multiplier (reference:
+            # "produce round numbers at the end of each epoch").
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+        self.verbose = verbose
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0 \
+                and runtime.world().controller_rank == 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr():g}.")

@@ -1,29 +1,173 @@
-"""Host coordination client — the multi-process eager control/data plane.
+"""Host coordination client — Python binding over the native core.
 
 This is the TPU-native analog of the reference's background-thread MPI
 negotiation (``BackgroundThreadLoop``, ``mpi_ops.cc:1248-1512``): name-keyed
 Request/Response messages to a rank-0 coordinator over DCN/TCP, cross-rank
-validation with the reference's error taxonomy, stall detection, and host-side
-execution of eager op-at-a-time collectives.
+validation with the reference's error taxonomy (``ConstructMPIResponse``,
+``mpi_ops.cc:266-474``), stall detection, tensor-fusion response batching and
+host-side execution of eager op-at-a-time collectives. The native core lives
+in ``coordinator.cc`` (built lazily into ``libhvdcoord.so``); this module is
+the ctypes binding (parity: ``mpi_ops.py:68-124`` loads the native lib via
+ctypes with a thin wrapper).
 
-Implemented in ``horovod_tpu/coord/`` (C++ core + this Python binding).
+Only the *eager* op-at-a-time API (metrics, epoch broadcast, init-time weight
+sync) uses this plane. Compiled collectives (``shard_map`` over the global
+mesh) span processes via XLA itself.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from ..exceptions import FailedPreconditionError, TransportError
+from ..utils import config as _config
+
+_REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2}
+
+# numpy dtype -> wire enum (coordinator.cc DType; the reference's nine dtypes
+# of mpi_message.h:26-36 plus bfloat16).
+_DTYPES = {
+    "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
+    "int64": 5, "float32": 6, "float64": 7, "bool": 8, "bfloat16": 9,
+}
+
+
+def _build_and_load() -> ctypes.CDLL:
+    here = os.path.dirname(os.path.abspath(__file__))
+    so = os.path.join(here, "libhvdcoord.so")
+    src = os.path.join(here, "coordinator.cc")
+    if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)):
+        # Concurrently launched ranks all reach this on a fresh checkout;
+        # serialize the build with an exclusive lock so nobody dlopens a
+        # half-written .so.
+        import fcntl
+        with open(os.path.join(here, ".build.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(so) or (
+                        os.path.exists(src)
+                        and os.path.getmtime(src) > os.path.getmtime(so)):
+                    subprocess.run(["make", "-C", here], check=True,
+                                   capture_output=True, text=True)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+    lib = ctypes.CDLL(so)
+    lib.hvdcoord_init.restype = ctypes.c_int
+    lib.hvdcoord_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p]
+    lib.hvdcoord_run.restype = ctypes.c_int
+    lib.hvdcoord_run.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_void_p,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p, ctypes.c_int]
+    lib.hvdcoord_free.argtypes = [ctypes.c_void_p]
+    lib.hvdcoord_shutdown.restype = None
+    return lib
+
 
 class CoordClient:
-    """Placeholder until the native coordination core lands.
+    """Per-process handle on the coordination plane."""
 
-    Compiled collectives (``shard_map`` over the global mesh) already span
-    processes via XLA — only the *eager* op-at-a-time API needs this plane.
-    ``init(coordinator=False)`` disables it explicitly.
-    """
+    def __init__(self, rank: int, size: int, host: str, port: int,
+                 timeline=None):
+        self._lib = _build_and_load()
+        self.rank = rank
+        self.size = size
+        tl_path = _config.timeline_path() if rank == 0 else None
+        rc = self._lib.hvdcoord_init(
+            rank, size, host.encode(), port,
+            _config.fusion_threshold_bytes(),
+            _config.stall_warning_secs(),
+            tl_path.encode() if tl_path else None)
+        if rc != 0:
+            raise TransportError(
+                f"coordination plane init failed (rank {rank}, "
+                f"{host}:{port}, rc={rc})")
+        # The coordinator (not Python) writes the timeline in coord mode.
+        self.timeline = None
 
     @classmethod
     def from_env(cls, rank: int, size: int, timeline=None) -> "CoordClient":
-        raise NotImplementedError(
-            "the multi-process eager coordination plane is not built yet; "
-            "compiled collectives (shard_map over the world mesh) already "
-            "span processes — pass init(coordinator=False) to proceed "
-            "without eager op-at-a-time collectives")
+        addr = _config.coordinator_address()
+        if addr is None:
+            raise TransportError(
+                "multi-process world without HVD_COORD_ADDR; launch via "
+                "tpurun or set HVD_COORD_ADDR=host:port")
+        host, _, port = addr.partition(":")
+        return cls(rank, size, host or "127.0.0.1", int(port or 29521),
+                   timeline=timeline)
+
+    # -- eager collectives -------------------------------------------------
+    def collective(self, kind: str, x, name: str, *, op=None, root_rank=0):
+        """Run one named eager collective through the host plane.
+
+        Semantics parity: eager ``hvd.allreduce/allgather/broadcast(value)``
+        (``horovod/keras/__init__.py:90-144``); errors surface as
+        FailedPreconditionError (``mpi_ops.cc:1141-1148``).
+        """
+        import jax.numpy as jnp
+        from ..ops.collectives import Op
+
+        arr = np.asarray(x)
+        average = False
+        if kind == "allreduce":
+            if op is not None and op not in (Op.SUM, Op.AVERAGE):
+                raise NotImplementedError(
+                    f"host coordination plane supports SUM/AVERAGE only "
+                    f"(reference parity); got {op}")
+            average = op is Op.AVERAGE
+        dtype_name = arr.dtype.name
+        if dtype_name not in _DTYPES:
+            raise TypeError(f"unsupported dtype {dtype_name} for eager "
+                            f"coordination-plane collective")
+
+        send_payload = not (kind == "broadcast" and self.rank != root_rank)
+        data = np.ascontiguousarray(arr) if send_payload else None
+
+        shape = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
+        out = ctypes.c_void_p()
+        out_nbytes = ctypes.c_longlong()
+        sizes = (ctypes.c_longlong * self.size)()
+        err = ctypes.create_string_buffer(4096)
+
+        rc = self._lib.hvdcoord_run(
+            name.encode(), _REQ_TYPES[kind], _DTYPES[dtype_name],
+            root_rank, arr.ndim, shape,
+            data.ctypes.data if data is not None else None,
+            data.nbytes if data is not None else 0,
+            ctypes.byref(out), ctypes.byref(out_nbytes), sizes, err,
+            len(err))
+        if rc == 1:
+            raise FailedPreconditionError(err.value.decode())
+        if rc != 0:
+            raise TransportError(err.value.decode())
+
+        raw = ctypes.string_at(out.value, out_nbytes.value)
+        self._lib.hvdcoord_free(out)
+        result = np.frombuffer(raw, dtype=arr.dtype)
+
+        if kind == "allreduce":
+            result = result.reshape(arr.shape)
+            if average:
+                result = (result // self.size).astype(arr.dtype) \
+                    if np.issubdtype(arr.dtype, np.integer) \
+                    else result / self.size
+        elif kind == "allgather":
+            total_rows = int(sum(sizes[i] for i in range(self.size)))
+            result = result.reshape((total_rows,) + tuple(arr.shape[1:]))
+        else:  # broadcast
+            result = result.reshape(arr.shape)
+        return jnp.asarray(result)
+
+    def shutdown(self):
+        self._lib.hvdcoord_shutdown()

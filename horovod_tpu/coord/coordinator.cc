@@ -1,0 +1,904 @@
+// hvdcoord — host coordination core for horovod_tpu.
+//
+// TPU-native analog of the reference's native runtime
+// (horovod/tensorflow/mpi_ops.cc): a rank-0 coordinator counts name-keyed
+// collective announcements from every rank, validates them across ranks with
+// the same error taxonomy (ConstructMPIResponse, mpi_ops.cc:266-474), detects
+// stalls (CheckForStalledTensors, mpi_ops.cc:1153-1196), plans tensor fusion
+// (mpi_ops.cc:1395-1422) and executes the *eager host data plane* — the
+// op-at-a-time collectives issued outside compiled XLA programs (metric
+// averaging, epoch broadcast, init-time weight sync). The compiled data plane
+// (gradient psum over ICI) never touches this code; XLA schedules it.
+//
+// Transport: length-prefixed binary messages over TCP (DCN stand-in) in a
+// star topology — every rank (including 0) connects as a client to the
+// coordinator server thread. This replaces the reference's
+// MPI_Send/Probe/Recv of FlatBuffers (mpi_ops.cc:1319-1374); the message
+// *content* is the same information, the wire format is our own.
+//
+// Threading model mirrors the reference's single-owner discipline
+// (SURVEY §5.2): all coordinator state is owned by the server thread; each
+// client has a receiver thread feeding a completed-op map guarded by one
+// mutex + condvar; enqueue serializes sends with a socket mutex.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <memory>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdcoord {
+
+// ---------------------------------------------------------------------------
+// Protocol constants (values are wire ABI; keep stable).
+// ---------------------------------------------------------------------------
+
+enum class ReqType : uint8_t { kAllreduce = 0, kAllgather = 1, kBroadcast = 2 };
+enum class RespType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kError = 3,
+  kShutdown = 4,
+};
+
+// Dtypes: the reference's nine (mpi_message.h:26-36) plus bfloat16 (TPU era).
+enum class DType : uint8_t {
+  kU8 = 0, kI8 = 1, kU16 = 2, kI16 = 3, kI32 = 4, kI64 = 5,
+  kF32 = 6, kF64 = 7, kBool = 8, kBF16 = 9,
+};
+
+const char* DTypeName(DType t) {
+  switch (t) {
+    case DType::kU8: return "uint8";
+    case DType::kI8: return "int8";
+    case DType::kU16: return "uint16";
+    case DType::kI16: return "int16";
+    case DType::kI32: return "int32";
+    case DType::kI64: return "int64";
+    case DType::kF32: return "float32";
+    case DType::kF64: return "float64";
+    case DType::kBool: return "bool";
+    case DType::kBF16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+const char* ReqTypeName(ReqType t) {
+  switch (t) {
+    case ReqType::kAllreduce: return "ALLREDUCE";
+    case ReqType::kAllgather: return "ALLGATHER";
+    case ReqType::kBroadcast: return "BROADCAST";
+  }
+  return "UNKNOWN";
+}
+
+// ---------------------------------------------------------------------------
+// Wire helpers: length-prefixed frames of {u8 tag, payload}.
+// ---------------------------------------------------------------------------
+
+enum class MsgTag : uint8_t { kRequest = 1, kResponse = 2, kShutdown = 3 };
+
+struct Request {
+  int32_t rank = -1;
+  ReqType type = ReqType::kAllreduce;
+  DType dtype = DType::kF32;
+  int32_t root_rank = -1;
+  std::vector<int64_t> shape;
+  std::string name;
+  std::string payload;  // tensor bytes (empty for non-root broadcast)
+};
+
+struct Response {
+  RespType type = RespType::kAllreduce;
+  std::string name;
+  std::string error;
+  std::vector<int64_t> sizes;  // allgather: per-rank first dims
+  std::string payload;         // result bytes
+  std::vector<std::string> fused_names;  // co-completed (fusion) group
+};
+
+class Buf {
+ public:
+  void PutU8(uint8_t v) { data_.push_back(static_cast<char>(v)); }
+  void PutI32(int32_t v) { Raw(&v, 4); }
+  void PutI64(int64_t v) { Raw(&v, 8); }
+  void PutStr(const std::string& s) {
+    PutI64(static_cast<int64_t>(s.size()));
+    data_.append(s);
+  }
+  void Raw(const void* p, size_t n) {
+    data_.append(reinterpret_cast<const char*>(p), n);
+  }
+  const std::string& str() const { return data_; }
+
+ private:
+  std::string data_;
+};
+
+class Reader {
+ public:
+  explicit Reader(const std::string& d) : d_(d) {}
+  uint8_t GetU8() { return static_cast<uint8_t>(d_[off_++]); }
+  int32_t GetI32() { int32_t v; memcpy(&v, d_.data() + off_, 4); off_ += 4; return v; }
+  int64_t GetI64() { int64_t v; memcpy(&v, d_.data() + off_, 8); off_ += 8; return v; }
+  std::string GetStr() {
+    int64_t n = GetI64();
+    std::string s = d_.substr(off_, n);
+    off_ += n;
+    return s;
+  }
+
+ private:
+  const std::string& d_;
+  size_t off_ = 0;
+};
+
+std::string EncodeRequest(const Request& r) {
+  Buf b;
+  b.PutU8(static_cast<uint8_t>(MsgTag::kRequest));
+  b.PutI32(r.rank);
+  b.PutU8(static_cast<uint8_t>(r.type));
+  b.PutU8(static_cast<uint8_t>(r.dtype));
+  b.PutI32(r.root_rank);
+  b.PutU8(static_cast<uint8_t>(r.shape.size()));
+  for (int64_t d : r.shape) b.PutI64(d);
+  b.PutStr(r.name);
+  b.PutStr(r.payload);
+  return b.str();
+}
+
+Request DecodeRequest(Reader& rd) {
+  Request r;
+  r.rank = rd.GetI32();
+  r.type = static_cast<ReqType>(rd.GetU8());
+  r.dtype = static_cast<DType>(rd.GetU8());
+  r.root_rank = rd.GetI32();
+  int nd = rd.GetU8();
+  for (int i = 0; i < nd; i++) r.shape.push_back(rd.GetI64());
+  r.name = rd.GetStr();
+  r.payload = rd.GetStr();
+  return r;
+}
+
+std::string EncodeResponse(const Response& r) {
+  Buf b;
+  b.PutU8(static_cast<uint8_t>(MsgTag::kResponse));
+  b.PutU8(static_cast<uint8_t>(r.type));
+  b.PutStr(r.name);
+  b.PutStr(r.error);
+  b.PutI32(static_cast<int32_t>(r.sizes.size()));
+  for (int64_t s : r.sizes) b.PutI64(s);
+  b.PutStr(r.payload);
+  return b.str();
+}
+
+Response DecodeResponse(Reader& rd) {
+  Response r;
+  r.type = static_cast<RespType>(rd.GetU8());
+  r.name = rd.GetStr();
+  r.error = rd.GetStr();
+  int n = rd.GetI32();
+  for (int i = 0; i < n; i++) r.sizes.push_back(rd.GetI64());
+  r.payload = rd.GetStr();
+  return r;
+}
+
+// Framed socket IO. Returns false on EOF/error.
+bool SendFrame(int fd, std::mutex& mu, const std::string& body) {
+  std::lock_guard<std::mutex> l(mu);
+  uint64_t len = body.size();
+  std::string frame(reinterpret_cast<char*>(&len), 8);
+  frame += body;
+  size_t off = 0;
+  while (off < frame.size()) {
+    ssize_t n = ::send(fd, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd, reinterpret_cast<char*>(p) + off, n - off, 0);
+    if (r <= 0) return false;
+    off += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool RecvFrame(int fd, std::string* body) {
+  uint64_t len;
+  if (!RecvAll(fd, &len, 8)) return false;
+  body->resize(len);
+  return len == 0 || RecvAll(fd, &(*body)[0], len);
+}
+
+// ---------------------------------------------------------------------------
+// Reduction kernels (host eager plane; SUM like the reference's MPI_SUM path,
+// mpi_ops.cc:1061-1064).
+// ---------------------------------------------------------------------------
+
+template <typename T>
+void SumInto(std::string* acc, const std::string& in) {
+  T* a = reinterpret_cast<T*>(&(*acc)[0]);
+  const T* b = reinterpret_cast<const T*>(in.data());
+  size_t n = in.size() / sizeof(T);
+  for (size_t i = 0; i < n; i++) a[i] += b[i];
+}
+
+// bfloat16: widen to float, add, narrow.
+void SumIntoBF16(std::string* acc, const std::string& in) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(&(*acc)[0]);
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(in.data());
+  size_t n = in.size() / 2;
+  for (size_t i = 0; i < n; i++) {
+    uint32_t av = static_cast<uint32_t>(a[i]) << 16;
+    uint32_t bv = static_cast<uint32_t>(b[i]) << 16;
+    float af, bf;
+    memcpy(&af, &av, 4);
+    memcpy(&bf, &bv, 4);
+    af += bf;
+    uint32_t out;
+    memcpy(&out, &af, 4);
+    // round-to-nearest-even on the dropped 16 bits
+    uint32_t rounded = out + 0x7FFF + ((out >> 16) & 1);
+    a[i] = static_cast<uint16_t>(rounded >> 16);
+  }
+}
+
+void SumPayload(DType t, std::string* acc, const std::string& in) {
+  switch (t) {
+    case DType::kU8: return SumInto<uint8_t>(acc, in);
+    case DType::kI8: return SumInto<int8_t>(acc, in);
+    case DType::kU16: return SumInto<uint16_t>(acc, in);
+    case DType::kI16: return SumInto<int16_t>(acc, in);
+    case DType::kI32: return SumInto<int32_t>(acc, in);
+    case DType::kI64: return SumInto<int64_t>(acc, in);
+    case DType::kF32: return SumInto<float>(acc, in);
+    case DType::kF64: return SumInto<double>(acc, in);
+    case DType::kBool: {
+      // logical OR for bool sum-parity (reference reduces bool via MPI sum
+      // of bytes; OR keeps it a valid bool)
+      uint8_t* a = reinterpret_cast<uint8_t*>(&(*acc)[0]);
+      const uint8_t* b = reinterpret_cast<const uint8_t*>(in.data());
+      for (size_t i = 0; i < in.size(); i++) a[i] = a[i] || b[i];
+      return;
+    }
+    case DType::kBF16: return SumIntoBF16(acc, in);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Chrome-trace timeline (reference: timeline.cc; doc docs/timeline.md).
+// Written by the coordinator only, covering every rank's readiness.
+// ---------------------------------------------------------------------------
+
+class Timeline {
+ public:
+  void Open(const std::string& path) {
+    f_ = fopen(path.c_str(), "w");
+    if (f_) fputs("[\n", f_);
+    start_ = Now();
+  }
+  ~Timeline() { Close(); }
+  void Close() {
+    if (f_) {
+      fputs("{}]\n", f_);
+      fclose(f_);
+      f_ = nullptr;
+    }
+  }
+  bool enabled() const { return f_ != nullptr; }
+
+  int64_t Now() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  int Pid(const std::string& name) {
+    auto it = pids_.find(name);
+    if (it != pids_.end()) return it->second;
+    int pid = static_cast<int>(pids_.size()) + 1;
+    pids_[name] = pid;
+    fprintf(f_,
+            "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
+            "\"args\":{\"name\":\"%s\"}},\n", pid, name.c_str());
+    return pid;
+  }
+
+  void Event(const std::string& name, const char* ph, const char* ev) {
+    if (!f_) return;
+    std::lock_guard<std::mutex> l(mu_);
+    fprintf(f_, "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld},\n",
+            ev, ph, Pid(name), static_cast<long long>(Now() - start_));
+    fflush(f_);
+  }
+
+ private:
+  FILE* f_ = nullptr;
+  int64_t start_ = 0;
+  std::mutex mu_;
+  std::unordered_map<std::string, int> pids_;
+};
+
+// ---------------------------------------------------------------------------
+// Coordinator (rank-0 server thread).
+// ---------------------------------------------------------------------------
+
+struct PendingTensor {
+  std::vector<Request> requests;   // one per announced rank
+  std::vector<bool> announced;     // by rank
+  std::chrono::steady_clock::time_point first_seen;
+  int count = 0;
+};
+
+class Coordinator {
+ public:
+  Coordinator(int size, int port, int64_t fusion_threshold, double stall_secs,
+              const std::string& timeline_path)
+      : size_(size), port_(port), fusion_threshold_(fusion_threshold),
+        stall_secs_(stall_secs) {
+    if (!timeline_path.empty()) timeline_.Open(timeline_path);
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        listen(listen_fd_, size_) != 0) {
+      perror("hvdcoord: coordinator bind/listen");
+      ok_ = false;
+      return;
+    }
+    thread_ = std::thread(&Coordinator::Serve, this);
+  }
+
+  ~Coordinator() {
+    shutdown_.store(true);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    if (thread_.joinable()) thread_.join();
+    for (int fd : client_fds_)
+      if (fd >= 0) ::close(fd);
+  }
+
+  bool ok() const { return ok_; }
+
+ private:
+  void Serve() {
+    // Accept exactly `size` clients; client's first frame is its rank (i32).
+    client_fds_.assign(size_, -1);
+    for (int i = 0; i < size_ && !shutdown_.load(); i++) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::string hello;
+      if (!RecvFrame(fd, &hello) || hello.size() != 4) { ::close(fd); return; }
+      int32_t rank;
+      memcpy(&rank, hello.data(), 4);
+      if (rank < 0 || rank >= size_ || client_fds_[rank] != -1) {
+        ::close(fd);
+        return;
+      }
+      client_fds_[rank] = fd;
+    }
+
+    // Tick loop (reference: 5 ms background tick, mpi_ops.cc:1293-1295; here
+    // poll() wakes on arrival, with the tick as stall-check granularity).
+    std::vector<pollfd> pfds(size_);
+    int done_ranks = 0;
+    while (!shutdown_.load()) {
+      for (int i = 0; i < size_; i++)
+        pfds[i] = {client_fds_[i], POLLIN, 0};
+      int n = ::poll(pfds.data(), pfds.size(), /*ms=*/5);
+      if (n < 0) break;
+      for (int i = 0; i < size_; i++) {
+        if (!(pfds[i].revents & POLLIN)) continue;
+        std::string body;
+        if (!RecvFrame(client_fds_[i], &body)) {
+          // Client gone: coordinated shutdown (mpi_ops.cc:1437-1447).
+          BroadcastShutdown();
+          return;
+        }
+        Reader rd(body);
+        MsgTag tag = static_cast<MsgTag>(rd.GetU8());
+        if (tag == MsgTag::kShutdown) {
+          if (++done_ranks == size_) {
+            BroadcastShutdown();
+            return;
+          }
+          continue;
+        }
+        Request req = DecodeRequest(rd);
+        Ingest(std::move(req));
+      }
+      DrainReady();
+      CheckStalls();
+    }
+  }
+
+  // IncrementTensorCount semantics (mpi_ops.cc:233-258).
+  void Ingest(Request req) {
+    auto& p = table_[req.name];
+    if (p.requests.empty()) {
+      p.announced.assign(size_, false);
+      p.first_seen = std::chrono::steady_clock::now();
+      arrival_order_.push_back(req.name);
+      if (timeline_.enabled()) timeline_.Event(req.name, "B", "NEGOTIATE");
+    }
+    if (timeline_.enabled()) {
+      std::ostringstream ev;
+      ev << "rank_" << req.rank << "_ready";
+      timeline_.Event(req.name, "i", ev.str().c_str());
+    }
+    if (!p.announced[req.rank]) {
+      p.announced[req.rank] = true;
+      p.count++;
+      p.requests.push_back(std::move(req));
+    }
+    // Duplicate announcement from the same rank for an in-flight name is
+    // dropped (Python auto-naming makes names unique per call).
+  }
+
+  // Process fully-announced tensors in strict arrival order. Tensor fusion
+  // (the reference's 64 MiB same-dtype response batching,
+  // mpi_ops.cc:1395-1422) lives in the COMPILED data plane here
+  // (ops/fusion.py buckets gradients into flat psums); the host eager plane
+  // carries small control-sized tensors where batching buys nothing, so
+  // each ready tensor is executed and answered individually.
+  void DrainReady() {
+    std::vector<std::string> ready;
+    for (auto it = arrival_order_.begin(); it != arrival_order_.end();) {
+      auto t = table_.find(*it);
+      if (t != table_.end() && t->second.count == size_) {
+        ready.push_back(*it);
+        it = arrival_order_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (auto& name : ready) {
+      Response resp = BuildResponse(name);
+      Emit(resp);
+    }
+  }
+
+  // ConstructMPIResponse parity (mpi_ops.cc:266-474): cross-rank validation
+  // with the reference's error taxonomy, then host execution.
+  Response BuildResponse(const std::string& name) {
+    auto it = table_.find(name);
+    auto requests = std::move(it->second.requests);
+    table_.erase(it);
+
+    Response resp;
+    resp.name = name;
+    std::ostringstream err;
+
+    // Order requests by rank for deterministic gather concat.
+    std::sort(requests.begin(), requests.end(),
+              [](const Request& a, const Request& b) { return a.rank < b.rank; });
+
+    DType dtype = requests[0].dtype;
+    for (auto& r : requests) {
+      if (r.dtype != dtype) {
+        err << "Mismatched data types: One rank had type " << DTypeName(dtype)
+            << ", but another rank had type " << DTypeName(r.dtype) << ".";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
+    }
+    ReqType op = requests[0].type;
+    for (auto& r : requests) {
+      if (r.type != op) {
+        err << "Mismatched collective operations: One rank did an "
+            << ReqTypeName(op) << ", but another rank did an "
+            << ReqTypeName(r.type) << ".";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
+    }
+
+    if (op == ReqType::kAllreduce || op == ReqType::kBroadcast) {
+      const auto& shape = requests[0].shape;
+      for (auto& r : requests) {
+        if (r.shape != shape) {
+          err << "Mismatched " << ReqTypeName(op)
+              << " tensor shapes: One rank sent a tensor of shape "
+              << ShapeStr(shape)
+              << ", but another rank sent a tensor of shape "
+              << ShapeStr(r.shape) << ".";
+          resp.type = RespType::kError;
+          resp.error = err.str();
+          return resp;
+        }
+      }
+    }
+
+    if (op == ReqType::kAllgather) {
+      const auto& shape0 = requests[0].shape;
+      if (shape0.empty()) {
+        err << "Rank zero tried to ALLGATHER a rank-zero tensor.";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
+      resp.sizes.assign(size_, 0);
+      for (auto& r : requests) {
+        if (r.shape.size() != shape0.size()) {
+          err << "Mismatched ALLGATHER tensor shapes: One rank sent a tensor "
+              << "of rank " << shape0.size()
+              << ", but another rank sent a tensor of rank "
+              << r.shape.size() << ".";
+          resp.type = RespType::kError;
+          resp.error = err.str();
+          return resp;
+        }
+        for (size_t d = 1; d < shape0.size(); d++) {
+          if (r.shape[d] != shape0[d]) {
+            err << "Mismatched ALLGATHER tensor shapes: One rank sent a "
+                << "tensor with dimension " << d << " equal to " << shape0[d]
+                << ", but another rank sent a tensor with dimension " << d
+                << " equal to " << r.shape[d] << ".";
+            resp.type = RespType::kError;
+            resp.error = err.str();
+            return resp;
+          }
+        }
+        resp.sizes[r.rank] = r.shape[0];
+      }
+    }
+
+    if (op == ReqType::kBroadcast) {
+      int root = requests[0].root_rank;
+      for (auto& r : requests) {
+        if (r.root_rank != root) {
+          err << "Mismatched BROADCAST root ranks: One rank specified root "
+              << "rank " << root << ", but another rank specified root rank "
+              << r.root_rank << ".";
+          resp.type = RespType::kError;
+          resp.error = err.str();
+          return resp;
+        }
+      }
+    }
+
+    // Execute the host data plane.
+    switch (op) {
+      case ReqType::kAllreduce: {
+        resp.type = RespType::kAllreduce;
+        resp.payload = requests[0].payload;
+        for (size_t r = 1; r < requests.size(); r++)
+          SumPayload(dtype, &resp.payload, requests[r].payload);
+        break;
+      }
+      case ReqType::kAllgather: {
+        resp.type = RespType::kAllgather;
+        for (auto& r : requests) resp.payload += r.payload;  // rank order
+        break;
+      }
+      case ReqType::kBroadcast: {
+        resp.type = RespType::kBroadcast;
+        resp.payload = requests[requests[0].root_rank].payload;
+        break;
+      }
+    }
+    return resp;
+  }
+
+  void Emit(Response& resp) {
+    if (timeline_.enabled()) {
+      timeline_.Event(resp.name, "E", "NEGOTIATE");
+      timeline_.Event(resp.name, "B",
+                      resp.type == RespType::kError ? "ERROR" : "EXECUTE");
+    }
+    std::string body = EncodeResponse(resp);
+    for (int r = 0; r < size_; r++) SendFrame(client_fds_[r], send_mu_, body);
+    if (timeline_.enabled())
+      timeline_.Event(resp.name, "E",
+                      resp.type == RespType::kError ? "ERROR" : "EXECUTE");
+  }
+
+  void BroadcastShutdown() {
+    Response resp;
+    resp.type = RespType::kShutdown;
+    resp.name = "__shutdown__";
+    std::string body = EncodeResponse(resp);
+    for (int r = 0; r < size_; r++)
+      if (client_fds_[r] >= 0) SendFrame(client_fds_[r], send_mu_, body);
+  }
+
+  // CheckForStalledTensors parity (mpi_ops.cc:1153-1196): warn on stderr for
+  // tensors waiting > stall_secs with only a subset of ranks ready.
+  void CheckStalls() {
+    auto now = std::chrono::steady_clock::now();
+    if (std::chrono::duration<double>(now - last_stall_check_).count() <
+        stall_secs_)
+      return;
+    last_stall_check_ = now;
+    bool preamble = false;
+    for (auto& name : arrival_order_) {
+      auto it = table_.find(name);
+      if (it == table_.end()) continue;
+      double waited =
+          std::chrono::duration<double>(now - it->second.first_seen).count();
+      if (waited > stall_secs_) {
+        if (!preamble) {
+          fprintf(stderr,
+                  "WARNING: One or more tensors were submitted to be reduced, "
+                  "gathered or broadcasted by subset of ranks and are waiting "
+                  "for remainder of ranks for more than %.0f seconds. This may "
+                  "indicate that different ranks are trying to submit "
+                  "different tensors or that only subset of ranks is "
+                  "submitting tensors, which will cause deadlock.\n",
+                  stall_secs_);
+          fprintf(stderr, "Stalled ops:");
+          preamble = true;
+        }
+        fprintf(stderr, "\n%s [ready ranks:", name.c_str());
+        for (int r = 0; r < size_; r++)
+          if (it->second.announced[r]) fprintf(stderr, " %d", r);
+        fprintf(stderr, "]");
+      }
+    }
+    if (preamble) fprintf(stderr, "\n");
+  }
+
+  static std::string ShapeStr(const std::vector<int64_t>& s) {
+    std::ostringstream o;
+    o << "[";
+    for (size_t i = 0; i < s.size(); i++) o << (i ? "," : "") << s[i];
+    o << "]";
+    return o.str();
+  }
+
+  int size_;
+  int port_;
+  int64_t fusion_threshold_;
+  double stall_secs_;
+  bool ok_ = true;
+  int listen_fd_ = -1;
+  std::vector<int> client_fds_;
+  std::thread thread_;
+  std::atomic<bool> shutdown_{false};
+  std::mutex send_mu_;
+  Timeline timeline_;
+
+  std::unordered_map<std::string, PendingTensor> table_;  // MessageTable
+  std::vector<std::string> arrival_order_;
+  std::chrono::steady_clock::time_point last_stall_check_ =
+      std::chrono::steady_clock::now();
+};
+
+// ---------------------------------------------------------------------------
+// Client (every rank, incl. 0): sends requests, receiver thread completes ops.
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  Client(int rank, int size, const std::string& host, int port)
+      : rank_(rank), size_(size) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    // Retry connect: the coordinator may not be up yet (launcher races).
+    for (int attempt = 0; attempt < 600; attempt++) {
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        connected_ = true;
+        break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      ::close(fd_);
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    }
+    if (!connected_) return;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::string hello(reinterpret_cast<char*>(&rank_), 4);
+    SendFrame(fd_, send_mu_, hello);
+    recv_thread_ = std::thread(&Client::RecvLoop, this);
+  }
+
+  ~Client() { Shutdown(); }
+
+  bool connected() const { return connected_; }
+
+  void Shutdown() {
+    if (shutdown_.exchange(true)) return;
+    if (connected_) {
+      Buf b;
+      b.PutU8(static_cast<uint8_t>(MsgTag::kShutdown));
+      SendFrame(fd_, send_mu_, b.str());
+    }
+    {
+      // Wake any waiters so they observe shutdown instead of blocking.
+      std::lock_guard<std::mutex> l(mu_);
+      cv_.notify_all();
+    }
+    if (recv_thread_.joinable()) recv_thread_.join();
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool Enqueue(const Request& req) {
+    if (!connected_) return false;
+    return SendFrame(fd_, send_mu_, EncodeRequest(req));
+  }
+
+  // Blocks until the named op completes; returns the response.
+  bool Wait(const std::string& name, Response* out) {
+    std::unique_lock<std::mutex> l(mu_);
+    cv_.wait(l, [&] {
+      return completed_.count(name) > 0 || dead_;
+    });
+    if (completed_.count(name) == 0) return false;
+    *out = std::move(completed_[name]);
+    completed_.erase(name);
+    return true;
+  }
+
+ private:
+  void RecvLoop() {
+    while (!shutdown_.load()) {
+      std::string body;
+      if (!RecvFrame(fd_, &body)) break;
+      Reader rd(body);
+      MsgTag tag = static_cast<MsgTag>(rd.GetU8());
+      if (tag != MsgTag::kResponse) break;
+      Response resp = DecodeResponse(rd);
+      if (resp.type == RespType::kShutdown) break;
+      std::lock_guard<std::mutex> l(mu_);
+      completed_[resp.name] = std::move(resp);
+      cv_.notify_all();
+    }
+    std::lock_guard<std::mutex> l(mu_);
+    dead_ = true;
+    cv_.notify_all();
+  }
+
+  int32_t rank_;
+  int size_;
+  int fd_ = -1;
+  bool connected_ = false;
+  std::mutex send_mu_;
+  std::thread recv_thread_;
+  std::atomic<bool> shutdown_{false};
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, Response> completed_;
+  bool dead_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// Global state + C ABI (parity: horovod_tensorflow_* C functions,
+// mpi_ops.cc:1516-1566; single-owner global like HorovodGlobalState).
+// ---------------------------------------------------------------------------
+
+struct Global {
+  std::unique_ptr<Coordinator> coordinator;
+  std::unique_ptr<Client> client;
+  int rank = -1;
+  int size = 0;
+  std::mutex mu;
+};
+
+Global* g() {
+  static Global instance;
+  return &instance;
+}
+
+}  // namespace hvdcoord
+
+extern "C" {
+
+// Returns 0 on success.
+int hvdcoord_init(int rank, int size, const char* host, int port,
+                  long long fusion_threshold, double stall_secs,
+                  const char* timeline_path) {
+  using namespace hvdcoord;
+  std::lock_guard<std::mutex> l(g()->mu);
+  if (g()->client) return 0;  // idempotent (InitializeHorovodOnce parity)
+  if (rank == 0) {
+    g()->coordinator.reset(new Coordinator(
+        size, port, fusion_threshold, stall_secs,
+        timeline_path ? timeline_path : ""));
+    if (!g()->coordinator->ok()) return 1;
+  }
+  g()->client.reset(new Client(rank, size, host, port));
+  if (!g()->client->connected()) return 2;
+  g()->rank = rank;
+  g()->size = size;
+  return 0;
+}
+
+int hvdcoord_rank() { return hvdcoord::g()->client ? hvdcoord::g()->rank : -1; }
+int hvdcoord_size() { return hvdcoord::g()->client ? hvdcoord::g()->size : -1; }
+
+// Submit + wait (eager calls are synchronous). Returns:
+//   0 ok; fills *out (malloc'd; caller frees via hvdcoord_free), *out_nbytes,
+//     and for allgather writes per-rank first dims into sizes_out[size].
+//   1 coordinator-reported validation error (message in err, FailedPrecondition
+//     parity, mpi_ops.cc:1141-1148); 2 transport failure.
+int hvdcoord_run(const char* name, int req_type, int dtype, int root_rank,
+                 int ndim, const long long* shape, const void* data,
+                 long long nbytes, void** out, long long* out_nbytes,
+                 long long* sizes_out, char* err, int errlen) {
+  using namespace hvdcoord;
+  auto* G = g();
+  if (!G->client) {
+    snprintf(err, errlen, "hvdcoord not initialized");
+    return 2;
+  }
+  Request req;
+  req.rank = G->rank;
+  req.type = static_cast<ReqType>(req_type);
+  req.dtype = static_cast<DType>(dtype);
+  req.root_rank = root_rank;
+  for (int i = 0; i < ndim; i++) req.shape.push_back(shape[i]);
+  req.name = name;
+  if (data && nbytes > 0)
+    req.payload.assign(reinterpret_cast<const char*>(data),
+                       static_cast<size_t>(nbytes));
+  if (!G->client->Enqueue(req)) {
+    snprintf(err, errlen, "hvdcoord: send failed (coordinator down?)");
+    return 2;
+  }
+  Response resp;
+  if (!G->client->Wait(req.name, &resp)) {
+    snprintf(err, errlen, "hvdcoord: connection lost while waiting for %s",
+             name);
+    return 2;
+  }
+  if (resp.type == RespType::kError) {
+    snprintf(err, errlen, "%s", resp.error.c_str());
+    return 1;
+  }
+  *out_nbytes = static_cast<long long>(resp.payload.size());
+  *out = malloc(resp.payload.size() ? resp.payload.size() : 1);
+  memcpy(*out, resp.payload.data(), resp.payload.size());
+  if (sizes_out) {
+    for (size_t i = 0; i < resp.sizes.size() && i < (size_t)G->size; i++)
+      sizes_out[i] = resp.sizes[i];
+  }
+  return 0;
+}
+
+void hvdcoord_free(void* p) { free(p); }
+
+void hvdcoord_shutdown() {
+  using namespace hvdcoord;
+  std::lock_guard<std::mutex> l(g()->mu);
+  if (g()->client) g()->client->Shutdown();
+  g()->client.reset();
+  g()->coordinator.reset();
+}
+
+}  // extern "C"

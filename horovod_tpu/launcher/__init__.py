@@ -1,0 +1,134 @@
+"""tpurun — the launcher replacing ``mpirun`` (reference: ``docs/running.md``).
+
+The reference is launched as ``mpirun -np N -H host:slots python train.py``
+with OpenMPI wiring rank/size env into every process. ``tpurun`` spawns one
+process per chip on a TPU VM (or N local processes for CPU testing) and sets:
+
+* ``HVD_RANK`` / ``HVD_SIZE`` / ``HVD_LOCAL_RANK`` — the process grid
+  (parity: ``OMPI_COMM_WORLD_RANK`` etc., read by tests
+  ``mpi_ops_test.py:31-63``).
+* ``HVD_COORD_ADDR`` — rendezvous address of the host coordination plane
+  (the out-of-band wire-up role MPI plays for the reference).
+* with ``--jax-distributed``: ``JAX_COORDINATOR_ADDRESS`` /
+  ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` so ``jax.distributed`` forms a
+  global device mesh and *compiled* collectives span processes over ICI/DCN.
+  Without it, processes are independent JAX worlds and cross-rank collectives
+  ride the host plane only (the reference's model: 1 process = 1 GPU,
+  ``README.md:62-64``).
+
+Usage::
+
+    python -m horovod_tpu.launcher -np 4 python examples/mnist.py
+    tpurun -np 4 python train.py          # if bin/ on PATH
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chips_per_host() -> int:
+    """Local chip count (local_rank domain — the analog of
+    MPI_Comm_split_type(SHARED) sizing, mpi_ops.cc:1263-1267).
+
+    Deliberately does NOT import jax: initializing a TPU backend in the
+    launcher would hold the chips and every spawned rank would fail with
+    "TPU already in use". Count device nodes instead.
+    """
+    import glob
+    override = os.environ.get("HVD_CHIPS_PER_HOST")
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            pass
+    for pattern in ("/dev/accel*", "/dev/vfio/[0-9]*"):
+        n = len(glob.glob(pattern))
+        if n:
+            return n
+    return 1
+
+
+def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
+           jax_distributed: bool = False, cpu: bool = False,
+           extra_env: Optional[dict] = None) -> int:
+    """Spawn ``np_`` ranks of ``command`` with the world env wired up.
+    Returns the first nonzero exit code (0 if all succeeded)."""
+    port = coord_port or _free_port()
+    jd_port = _free_port() if jax_distributed else None
+    procs = []
+
+    def _terminate(signum, frame):
+        for p in procs:
+            p.terminate()
+    old = signal.signal(signal.SIGTERM, _terminate)
+
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.update(extra_env or {})
+            env["HVD_RANK"] = str(rank)
+            env["HVD_SIZE"] = str(np_)
+            env["HVD_LOCAL_RANK"] = str(rank % max(1, _chips_per_host()
+                                                   if not cpu else np_))
+            env["HVD_COORD_ADDR"] = f"127.0.0.1:{port}"
+            if cpu:
+                # CPU testing mode (reference CI: mpirun -np 2 on localhost
+                # CPU-only, .travis.yml:84-91).
+                env["JAX_PLATFORMS"] = "cpu"
+            if jax_distributed:
+                env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jd_port}"
+                env["JAX_NUM_PROCESSES"] = str(np_)
+                env["JAX_PROCESS_ID"] = str(rank)
+            procs.append(subprocess.Popen(command, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            if p.returncode and not rc:
+                rc = p.returncode
+        return rc
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Launch N ranks of a training script on this host "
+                    "(mpirun replacement; see docs/running.md parity).")
+    parser.add_argument("-np", type=int, required=True,
+                        help="number of ranks (processes) to spawn")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force JAX CPU backend in ranks (CI/testing)")
+    parser.add_argument("--jax-distributed", action="store_true",
+                        help="also form a jax.distributed world so compiled "
+                             "collectives span processes")
+    parser.add_argument("--coord-port", type=int, default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the command to run, e.g. python train.py")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    return launch(args.np, args.command, coord_port=args.coord_port,
+                  jax_distributed=args.jax_distributed, cpu=args.cpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Model families matching the reference's example workloads
+(``examples/``: MNIST CNNs, CIFAR ResNet v1/v2, ImageNet ResNet-50,
+skip-gram word2vec), implemented as flax.linen modules designed for the MXU
+(bfloat16 activations, static shapes, XLA-fusable blocks)."""
+
+from .mnist import MnistCNN  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    BasicBlock,
+    BottleneckBlock,
+    PreActBlock,
+    cifar_resnet_v1,
+    cifar_resnet_v2,
+    resnet50,
+    resnet101,
+)
+from .word2vec import SkipGram, embedding_grads_as_slices  # noqa: F401

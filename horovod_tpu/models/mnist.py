@@ -1,0 +1,39 @@
+"""MNIST conv net — the reference's smallest end-to-end workload.
+
+Parity: the conv net in ``examples/tensorflow_mnist.py:29-54`` (two 5x5 conv
++ pool stages, 1024-unit dense, 10-way logits) and ``examples/keras_mnist.py``
+(3x3 convs, dropout). One model serves both example families.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """Conv net matching ``tensorflow_mnist.py``'s ``conv_model``."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # Accepts [B, 784] or [B, 28, 28, 1].
+        if x.ndim == 2:
+            x = x.reshape((-1, 28, 28, 1))
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,76 @@
+"""Skip-gram word2vec — the reference's sparse-gradient workload.
+
+Parity: ``examples/tensorflow_word2vec.py`` (skip-gram with NCE loss over a
+50k vocabulary; its embedding gradients are ``tf.IndexedSlices``, which is
+what exercises the sparse allgather path,
+``horovod/tensorflow/__init__.py:61-72``).
+
+TPU-native design: embeddings are a plain [vocab, dim] param; the loss uses
+sampled negatives (static ``num_sampled`` shape, XLA-friendly — TF's NCE
+sampler is replaced by caller-provided negative ids so the step stays
+shape-static). :func:`embedding_grads_as_slices` converts the dense embedding
+gradient of a batch into an :class:`~horovod_tpu.ops.sparse.IndexedSlices`
+(the touched rows and their grads) so ``DistributedOptimizer`` takes the
+two-allgather sparse path just as the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.sparse import IndexedSlices
+
+
+class SkipGram(nn.Module):
+    """Skip-gram with sampled-softmax (NCE-style) loss."""
+
+    vocab_size: int = 50000
+    embedding_size: int = 128
+
+    @nn.compact
+    def __call__(self, center_ids, context_ids, negative_ids):
+        """Returns the mean NCE-style loss for a batch.
+
+        Args:
+          center_ids:   [B] int ids of center words.
+          context_ids:  [B] int ids of true context words (positives).
+          negative_ids: [B, K] int ids of sampled negatives.
+        """
+        emb = self.param(
+            "embeddings",
+            # U[-1, 1) zero-mean init (tensorflow_word2vec.py:157 parity).
+            lambda key, shape: jax.random.uniform(
+                key, shape, minval=-1.0, maxval=1.0),
+            (self.vocab_size, self.embedding_size))
+        nce_w = self.param(
+            "nce_weights",
+            nn.initializers.truncated_normal(
+                stddev=1.0 / jnp.sqrt(self.embedding_size)),
+            (self.vocab_size, self.embedding_size))
+        nce_b = self.param("nce_biases", nn.initializers.zeros,
+                           (self.vocab_size,))
+
+        h = emb[center_ids]                                   # [B, D]
+        pos_logit = jnp.einsum("bd,bd->b", h, nce_w[context_ids]) \
+            + nce_b[context_ids]                              # [B]
+        neg_logit = jnp.einsum("bd,bkd->bk", h, nce_w[negative_ids]) \
+            + nce_b[negative_ids]                             # [B, K]
+
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+        return jnp.mean(pos_loss + neg_loss)
+
+
+def embedding_grads_as_slices(dense_grad: jax.Array,
+                              touched_ids: jax.Array) -> IndexedSlices:
+    """Convert a dense [vocab, dim] embedding gradient into IndexedSlices
+    over the batch's touched rows — recreating the sparse form TF produces
+    natively (``tf.IndexedSlices``), which routes ``DistributedOptimizer``
+    through the reference's two-allgather sparse path."""
+    values = dense_grad[touched_ids]
+    return IndexedSlices(values=values, indices=touched_ids,
+                         dense_shape=tuple(dense_grad.shape))

@@ -72,6 +72,7 @@ class World:
     process_count: int
     coord: Any = None           # coordination client (multi-process eager plane)
     timeline: Any = None        # Timeline writer (rank 0 only)
+    env_world: bool = False     # tpurun env-world (independent JAX processes)
 
 
 _lock = threading.Lock()
@@ -106,21 +107,33 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
         process_index = jax.process_index()
         process_count = jax.process_count()
 
-        # Controller rank: global index of the first device owned by this
-        # process. One-process-per-chip (tpurun) → this is the MPI-style rank.
-        controller_rank = 0
-        for i, d in enumerate(devs):
-            if d.process_index == process_index:
-                controller_rank = i
-                break
+        # tpurun env-world: one *independent* JAX process per chip (the
+        # reference's "1 MPI process = 1 GPU" model, README.md:62-64) —
+        # jax.distributed is not set up, rank/size come from launcher env
+        # and ALL cross-rank collectives ride the host coordination plane.
+        env_size = _config.launcher_size(default=1)
+        env_world = process_count == 1 and env_size > 1 and devices is None
+        if env_world:
+            size = env_size
+            process_index = _config.launcher_rank(default=0)
+            process_count = env_size
+            controller_rank = process_index
+            # 1 process = 1 chip (README.md:62-64): the local mesh is this
+            # rank's own device; cross-rank exchange rides the host plane.
+            local = jax.local_devices()
+            own = local[_config.launcher_local_rank(default=0) % len(local)]
+            mesh = Mesh(np.array([own]), (AXIS,))
+        else:
+            # Controller rank: global index of the first device owned by
+            # this process (jax.distributed multi-host, or single
+            # controller). One-process-per-chip → the MPI-style rank.
+            controller_rank = 0
+            for i, d in enumerate(devs):
+                if d.process_index == process_index:
+                    controller_rank = i
+                    break
 
         local_rank = _config.launcher_local_rank(default=_infer_local_rank(devs, process_index))
-
-        timeline = None
-        tl_path = _config.timeline_path()
-        if tl_path and controller_rank == 0:
-            from .utils.timeline import Timeline
-            timeline = Timeline(tl_path)
 
         coord = None
         if coordinator is None:
@@ -130,6 +143,16 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
                 "init(coordinator=True) requires a multi-process world; "
                 "single-controller mode has no cross-process negotiation "
                 "to coordinate")
+
+        timeline = None
+        tl_path = _config.timeline_path()
+        if tl_path and controller_rank == 0 and not coordinator:
+            # Single-controller: Python writes the timeline. In coord mode
+            # the native coordinator owns the file (coordinator.cc Timeline)
+            # — opening it here too would corrupt it.
+            from .utils.timeline import Timeline
+            timeline = Timeline(tl_path)
+
         if coordinator and process_count > 1:
             from .coord.client import CoordClient
             coord = CoordClient.from_env(
@@ -144,6 +167,7 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
             process_count=process_count,
             coord=coord,
             timeline=timeline,
+            env_world=env_world,
         )
         return _world
 

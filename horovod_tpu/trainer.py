@@ -1,0 +1,158 @@
+"""Trainer: the ``model.fit`` analog driving the compiled step + callbacks.
+
+Parity: the reference's training loops are Keras ``model.fit`` with Horovod
+callbacks (``examples/keras_mnist_advanced.py:80-110``) or raw
+``MonitoredTrainingSession`` loops (``examples/tensorflow_mnist.py:99-119``).
+This Trainer is the thin host-side loop around the jitted SPMD train step:
+epochs × steps, invoking :mod:`horovod_tpu.callbacks` hooks, rank-0-only
+verbosity (``keras_imagenet_resnet50.py:59`` convention), and rank-0-only
+checkpointing (SURVEY §5.4) via orbax.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from . import runtime
+from .training import TrainState, shard_batch
+
+
+class Trainer:
+    """Host training loop; owns the mutable ``state`` that callbacks adjust."""
+
+    def __init__(self, train_step: Callable, state: TrainState,
+                 *, eval_step: Optional[Callable] = None,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: Optional[bool] = None):
+        self.train_step = train_step
+        self.eval_step = eval_step
+        self.state = state
+        self.steps_per_epoch = steps_per_epoch
+        if verbose is None:
+            verbose = (not runtime.is_initialized()
+                       or runtime.world().controller_rank == 0)
+        self.verbose = verbose
+        self.history: List[Dict[str, float]] = []
+
+    def fit(self, data: Callable[[], Iterable], epochs: int = 1,
+            callbacks: Optional[List] = None,
+            eval_data: Optional[Callable[[], Iterable]] = None,
+            initial_epoch: int = 0):
+        """Run the training loop.
+
+        Args:
+          data: zero-arg callable returning a fresh per-epoch iterable of
+            ``(inputs, labels)`` host batches (global batch; sharded here).
+          epochs: final epoch (exclusive).
+          callbacks: list of :class:`horovod_tpu.callbacks.Callback`.
+          eval_data: optional eval-batch iterable factory, run at epoch end.
+          initial_epoch: first epoch — nonzero after checkpoint resume (the
+            reference broadcasts the resume epoch from rank 0,
+            ``keras_imagenet_resnet50.py:47-56``).
+        """
+        callbacks = list(callbacks or [])
+        for cb in callbacks:
+            cb.set_trainer(self)
+
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(initial_epoch, epochs):
+            t0 = time.perf_counter()
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            nsteps = 0
+            last_metrics: Dict[str, Any] = {}
+            for batch_idx, batch in enumerate(data()):
+                if self.steps_per_epoch is not None \
+                        and batch_idx >= self.steps_per_epoch:
+                    break
+                for cb in callbacks:
+                    cb.on_batch_begin(batch_idx)
+                self.state, metrics = self.train_step(
+                    self.state, shard_batch(batch))
+                last_metrics = metrics
+                for cb in callbacks:
+                    cb.on_batch_end(batch_idx)
+                nsteps += 1
+            if self.steps_per_epoch is None:
+                self.steps_per_epoch = nsteps
+
+            logs = {k: float(np.asarray(v)) for k, v in last_metrics.items()}
+            if eval_data is not None and self.eval_step is not None:
+                evals = [self.eval_step(self.state, shard_batch(b))
+                         for b in eval_data()]
+                if evals:  # the eval iterable can be empty at large world sizes
+                    for k in evals[0]:
+                        logs[f"val_{k}"] = float(np.mean(
+                            [np.asarray(e[k]) for e in evals]))
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            self.history.append(logs)
+            if self.verbose:
+                dt = time.perf_counter() - t0
+                msg = " ".join(f"{k}={v:.4f}" for k, v in logs.items())
+                print(f"epoch {epoch + 1}/{epochs} [{dt:.1f}s, "
+                      f"{nsteps} steps] {msg}")
+        for cb in callbacks:
+            cb.on_train_end()
+        return self.history
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume — rank-0-only write + broadcast-on-restore (SURVEY §5.4).
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(directory: str, state: TrainState,
+                    step: Optional[int] = None) -> Optional[str]:
+    """Write a checkpoint — rank 0 only, like the reference
+    (``checkpoint_dir=None`` on other ranks, ``README.md:78-80``).
+    Returns the path written, or None on non-root ranks."""
+    if runtime.is_initialized() and runtime.world().controller_rank != 0:
+        return None
+    import orbax.checkpoint as ocp
+    step = int(state.step) if step is None else step
+    path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, jax.tree_util.tree_map(np.asarray, state), force=True)
+    return path
+
+
+def latest_checkpoint_step(directory: str) -> Optional[int]:
+    """Find the newest checkpoint's step (the resume scan rank 0 performs
+    before broadcasting the epoch, ``keras_imagenet_resnet50.py:47-56``)."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("ckpt_"):
+            try:
+                steps.append(int(name.split("_", 1)[1]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, state: TrainState,
+                       step: Optional[int] = None) -> TrainState:
+    """Restore (on every rank, from the shared filesystem) then broadcast
+    from rank 0 so all ranks are bit-identical — the reference's
+    load-on-rank-0 + ``BroadcastGlobalVariablesCallback`` protocol
+    (``keras_imagenet_resnet50.py:130-133``)."""
+    import orbax.checkpoint as ocp
+    from .optimizer import broadcast_global_variables
+    if step is None:
+        step = latest_checkpoint_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(
+        path, item=jax.tree_util.tree_map(np.asarray, state))
+    if runtime.is_initialized() and runtime.size() > 1:
+        restored = broadcast_global_variables(restored, root_rank=0)
+    return restored

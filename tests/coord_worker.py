@@ -1,0 +1,90 @@
+"""Worker process for coordination-plane tests (the role one MPI rank plays
+in the reference's ``mpirun -np 2 python mpi_ops_test.py`` CI,
+``.travis.yml:91``). Exercises the host eager plane end-to-end and asserts
+algebraic identities derived from rank/size (SURVEY §4 test strategy)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.coord.client import CoordClient  # noqa: E402
+from horovod_tpu.exceptions import FailedPreconditionError  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HVD_RANK"])
+    size = int(os.environ["HVD_SIZE"])
+    host, _, port = os.environ["HVD_COORD_ADDR"].partition(":")
+    client = CoordClient(rank, size, host, int(port))
+
+    try:
+        # Allreduce: sum of per-rank tensors == analytic total.
+        x = np.full((4, 3), float(rank + 1), np.float32)
+        out = np.asarray(client.collective("allreduce", x, "t.allreduce"))
+        expected = sum(r + 1 for r in range(size))
+        assert np.allclose(out, expected), (out, expected)
+
+        # Allreduce int64 + bfloat16 dtype coverage.
+        xi = np.arange(6, dtype=np.int64) * (rank + 1)
+        outi = np.asarray(client.collective("allreduce", xi, "t.allreduce.i64"))
+        assert np.array_equal(outi, np.arange(6) * sum(
+            r + 1 for r in range(size))), outi
+
+        # Ragged allgather: rank r contributes r+1 rows of constant r.
+        rows = np.full((rank + 1, 2), float(rank), np.float32)
+        g = np.asarray(client.collective("allgather", rows, "t.allgather"))
+        assert g.shape[0] == sum(r + 1 for r in range(size)), g.shape
+        off = 0
+        for r in range(size):
+            assert np.allclose(g[off:off + r + 1], float(r)), (r, g)
+            off += r + 1
+
+        # Broadcast: everyone ends with the root's tensor.
+        root = size - 1
+        if rank == root:
+            b = np.arange(5, dtype=np.float64) * 7
+        else:
+            b = np.zeros(5, np.float64)
+        out_b = np.asarray(client.collective("broadcast", b, "t.bcast",
+                                             root_rank=root))
+        assert np.allclose(out_b, np.arange(5) * 7), out_b
+
+        # Negative tests need >1 rank to produce a mismatch; self-skip at
+        # size 1 like the reference's (mpi_ops_test.py:291-293).
+        if size > 1:
+            # Mismatched allreduce shapes -> FailedPrecondition on every
+            # rank (ConstructMPIResponse ERROR path, mpi_ops.cc:1141-1148).
+            bad = np.zeros((rank + 1,), np.float32)
+            try:
+                client.collective("allreduce", bad, "t.mismatch")
+                raise SystemExit("expected FailedPreconditionError")
+            except FailedPreconditionError as e:
+                assert "Mismatched ALLREDUCE tensor shapes" in str(e), e
+
+            # Mismatched dtypes.
+            bad2 = (np.zeros(3, np.float32) if rank == 0
+                    else np.zeros(3, np.float64))
+            try:
+                client.collective("allreduce", bad2, "t.dtype")
+                raise SystemExit("expected FailedPreconditionError")
+            except FailedPreconditionError as e:
+                assert "Mismatched data types" in str(e), e
+
+            # Divergent root_rank.
+            try:
+                client.collective("broadcast", np.zeros(2, np.float32),
+                                  "t.root", root_rank=rank % 2)
+                raise SystemExit("expected FailedPreconditionError")
+            except FailedPreconditionError as e:
+                assert "Mismatched BROADCAST root ranks" in str(e), e
+
+        print(f"rank {rank}: OK", flush=True)
+    finally:
+        client.shutdown()
+
+
+if __name__ == "__main__":
+    main()

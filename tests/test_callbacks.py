@@ -1,0 +1,145 @@
+"""Callback parity tests (reference: ``horovod/keras/callbacks.py``):
+warmup formula endpoints, momentum correction restore, metric averaging,
+broadcast at train begin, and checkpoint save/restore round-trip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import callbacks, models, trainer as trainer_mod, training
+
+
+def _mnist_setup(lr=0.1, momentum=0.9):
+    model = models.MnistCNN()
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 784)),
+        callbacks.hyper_sgd(lr, momentum=momentum))
+    step = training.make_train_step(model, dist_opt, donate=False)
+    return model, state, step
+
+
+def _toy_batches(n_batches=2, n=16):
+    rng = np.random.RandomState(0)
+    return [(jnp.asarray(rng.randn(n, 784), jnp.float32),
+             jnp.asarray(rng.randint(0, 10, size=(n,))))
+            for _ in range(n_batches)]
+
+
+class TestHyperparams:
+    def test_get_set_lr(self):
+        _, state, _ = _mnist_setup(lr=0.25)
+        assert callbacks.get_hyperparam(state.opt_state,
+                                        "learning_rate") == 0.25
+        new = callbacks.set_hyperparam(state.opt_state, "learning_rate", 0.5)
+        assert callbacks.get_hyperparam(new, "learning_rate") == 0.5
+
+
+class TestWarmup:
+    def test_warmup_endpoints(self):
+        """lr'(0) == lr/size and lr'(warmup end) == lr
+        (callbacks.py:202-233 math recap)."""
+        _, state, step = _mnist_setup(lr=0.8)
+        t = trainer_mod.Trainer(step, state, steps_per_epoch=2, verbose=False)
+        warmup = callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=2, momentum_correction=False)
+        batches = _toy_batches(2)
+        size = hvd.size()
+
+        lrs = []
+        orig_batch_begin = warmup.on_batch_begin
+
+        def spy(batch, logs=None):
+            orig_batch_begin(batch, logs)
+            lrs.append(callbacks.get_hyperparam(
+                t.state.opt_state, "learning_rate"))
+        warmup.on_batch_begin = spy
+
+        t.fit(lambda: batches, epochs=2, callbacks=[warmup])
+        # First adjusted batch: epoch'=(0 + 1/steps) → lr/size*(eps*(size-1)/w+1)
+        expected_first = 0.8 / size * ((0.5) * (size - 1) / 2 + 1)
+        np.testing.assert_allclose(lrs[0], expected_first, rtol=1e-6)
+        # Last batch of warmup: epoch' hits warmup_epochs exactly → full lr.
+        np.testing.assert_allclose(lrs[-1], 0.8, rtol=1e-6)
+
+    def test_momentum_correction_restores(self):
+        _, state, step = _mnist_setup(lr=0.4, momentum=0.9)
+        t = trainer_mod.Trainer(step, state, steps_per_epoch=2, verbose=False)
+        cb = callbacks.LearningRateScheduleCallback(
+            multiplier=lambda e: 0.5, start_epoch=0, staircase=True,
+            momentum_correction=True)
+        momenta = []
+
+        class Probe(callbacks.Callback):
+            def on_batch_begin(self, batch, logs=None):
+                momenta.append(("begin", callbacks.get_hyperparam(
+                    t.state.opt_state, "momentum")))
+
+            def on_batch_end(self, batch, logs=None):
+                momenta.append(("end", callbacks.get_hyperparam(
+                    t.state.opt_state, "momentum")))
+
+        # Order matters: cb adjusts on batch begin before Probe reads.
+        t.fit(lambda: _toy_batches(2), epochs=1, callbacks=[cb, Probe()])
+        # During first batch momentum was scaled by new_lr/old_lr = 0.5 …
+        assert momenta[0] == ("begin", pytest.approx(0.45))
+        # … and restored after the batch (callbacks.py:168-172).
+        assert momenta[1] == ("end", pytest.approx(0.9))
+        # Batch 1 (staircase, not batch 0): untouched.
+        assert momenta[2] == ("begin", pytest.approx(0.9))
+
+    def test_constant_multiplier_staircase(self):
+        _, state, step = _mnist_setup(lr=1.0)
+        t = trainer_mod.Trainer(step, state, steps_per_epoch=1, verbose=False)
+        cb = callbacks.LearningRateScheduleCallback(
+            multiplier=0.1, start_epoch=1, momentum_correction=False)
+        history = t.fit(lambda: _toy_batches(1), epochs=2, callbacks=[cb])
+        assert history[0]["lr"] == pytest.approx(1.0)   # epoch 0: untouched
+        assert history[1]["lr"] == pytest.approx(0.1)   # epoch 1: 1.0 * 0.1
+
+
+class TestMetricAverage:
+    def test_scalar_metrics_averaged(self):
+        cb = callbacks.MetricAverageCallback()
+        logs = {"loss": 2.0, "acc": np.float32(0.5), "name": "skip-me"}
+        cb.on_epoch_end(0, logs)
+        # Single-controller world: every rank contributes the same value, so
+        # the average is the identity — but the collective must execute.
+        assert logs["loss"] == pytest.approx(2.0)
+        assert logs["acc"] == pytest.approx(0.5)
+        assert logs["name"] == "skip-me"
+
+
+class TestBroadcastCallback:
+    def test_state_broadcast_noop_single_controller(self):
+        _, state, step = _mnist_setup()
+        t = trainer_mod.Trainer(step, state, verbose=False)
+        before = np.asarray(
+            jax.tree_util.tree_leaves(t.state.params)[0]).copy()
+        cb = callbacks.BroadcastGlobalVariablesCallback(0)
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        after = np.asarray(jax.tree_util.tree_leaves(t.state.params)[0])
+        np.testing.assert_allclose(before, after)
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        _, state, step = _mnist_setup()
+        batch = _toy_batches(1)[0]
+        state, _ = step(state, training.shard_batch(batch))
+        path = trainer_mod.save_checkpoint(str(tmp_path), state)
+        assert path is not None and os.path.exists(path)
+        assert trainer_mod.latest_checkpoint_step(str(tmp_path)) == 1
+
+        _, fresh, _ = _mnist_setup()
+        restored = trainer_mod.restore_checkpoint(str(tmp_path), fresh)
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(restored.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+        assert int(restored.step) == 1

@@ -1,0 +1,53 @@
+"""Coordination-plane tests: spawn N OS processes against the native
+coordinator (the analog of the reference CI's ``mpirun -np 2 python
+mpi_ops_test.py``, ``.travis.yml:91``)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "coord_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_world(size: int, timeout: int = 120):
+    port = _free_port()
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ,
+                   HVD_RANK=str(rank), HVD_SIZE=str(size),
+                   HVD_COORD_ADDR=f"127.0.0.1:{port}",
+                   # Workers only need numpy+jnp; keep jax on CPU and quiet.
+                   JAX_PLATFORMS="cpu", PYTHONPATH="")
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
+@pytest.mark.parametrize("size", [1, 2, 4])
+def test_coord_world(size):
+    outs = _spawn_world(size)
+    for rank, (rc, out) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank}: OK" in out

@@ -1,0 +1,22 @@
+"""tpurun end-to-end: launch N ranks of a public-API worker and check every
+rank's collectives (the reference CI's mpirun-based integration shape,
+``.travis.yml:84-108``)."""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "launcher_worker.py")
+
+
+def test_tpurun_three_ranks():
+    env = dict(os.environ, PYTHONPATH="")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launcher", "-np", "3", "--cpu",
+         sys.executable, WORKER],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for r in range(3):
+        assert f"rank {r}/3: LAUNCHER OK" in out.stdout, out.stdout

@@ -1,0 +1,126 @@
+"""Model family tests: forward shapes + a compiled data-parallel train step
+that actually learns (loss decreases) — the analog of the reference's
+examples-as-integration-tests CI (``.travis.yml:93-108`` runs shrunken
+MNIST/Keras examples end-to-end)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import models, training
+
+
+class TestModelShapes:
+    def test_mnist_cnn(self):
+        m = models.MnistCNN()
+        v = m.init(jax.random.PRNGKey(0), jnp.zeros((2, 784)), train=False)
+        out = m.apply(v, jnp.zeros((2, 784)), train=False)
+        assert out.shape == (2, 10)
+
+    @pytest.mark.parametrize("depth", [20, 56])
+    def test_cifar_v1(self, depth):
+        m = models.cifar_resnet_v1(depth, dtype=jnp.float32)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+        assert "batch_stats" in v
+
+    def test_cifar_v2(self):
+        m = models.cifar_resnet_v2(56, dtype=jnp.float32)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+
+    def test_v1_v2_depth_validation(self):
+        with pytest.raises(ValueError):
+            models.cifar_resnet_v1(21)
+        with pytest.raises(ValueError):
+            models.cifar_resnet_v2(22)
+
+    def test_resnet50_tiny_input(self):
+        m = models.resnet50(num_classes=7, dtype=jnp.float32)
+        x = jnp.zeros((2, 64, 64, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 7)
+
+    def test_word2vec_loss_scalar(self):
+        m = models.SkipGram(vocab_size=100, embedding_size=16)
+        center = jnp.array([1, 2, 3])
+        context = jnp.array([4, 5, 6])
+        neg = jnp.array([[7, 8], [9, 10], [11, 12]])
+        v = m.init(jax.random.PRNGKey(0), center, context, neg)
+        loss = m.apply(v, center, context, neg)
+        assert loss.shape == ()
+        assert jnp.isfinite(loss)
+
+
+class TestTrainStep:
+    def _toy_batch(self, n=16, key=0):
+        rng = np.random.RandomState(key)
+        x = rng.randn(n, 784).astype(np.float32)
+        y = rng.randint(0, 10, size=(n,))
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def test_mnist_train_step_learns(self):
+        model = models.MnistCNN()
+        state, dist_opt = training.create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 784)),
+            optax.sgd(0.05))
+        step = training.make_train_step(model, dist_opt)
+        batch = training.shard_batch(self._toy_batch())
+        losses = []
+        for _ in range(8):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+        assert int(state.step) == 8
+
+    def test_resnet_train_step_runs_with_batch_stats(self):
+        model = models.cifar_resnet_v1(20, dtype=jnp.float32,
+                                       axis_name=hvd.AXIS)
+        x = jnp.zeros((8, 32, 32, 3))
+        state, dist_opt = training.create_train_state(
+            model, jax.random.PRNGKey(0), x, optax.sgd(0.1, momentum=0.9))
+        assert state.batch_stats is not None
+        step = training.make_train_step(model, dist_opt)
+        rng = np.random.RandomState(0)
+        batch = training.shard_batch(
+            (jnp.asarray(rng.randn(8, 32, 32, 3), jnp.float32),
+             jnp.asarray(rng.randint(0, 10, size=(8,)))))
+        # Copy out before the step: donate_argnums invalidates state buffers.
+        old_stats = np.asarray(jax.tree_util.tree_leaves(state.batch_stats)[0])
+        state, metrics = step(state, batch)
+        assert jnp.isfinite(metrics["loss"])
+        new_stats = np.asarray(jax.tree_util.tree_leaves(state.batch_stats)[0])
+        # BN running stats must update (mutable collection threaded through).
+        assert not np.allclose(old_stats, new_stats)
+
+    def test_eval_step_metrics_finite(self):
+        model = models.MnistCNN()
+        state, dist_opt = training.create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 784)),
+            optax.sgd(0.05))
+        eval_step = training.make_eval_step(model)
+        batch = training.shard_batch(self._toy_batch())
+        metrics = eval_step(state, batch)
+        assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+        assert jnp.isfinite(metrics["loss"])
+
+    def test_optimizer_state_is_plain_optax(self):
+        """Checkpoint-compat parity: DistributedOptimizer state must be
+        bit-identical in structure to the wrapped optimizer's state
+        (the reference's Keras dynamic-subclass trick,
+        keras/__init__.py:81-87)."""
+        model = models.MnistCNN()
+        inner = optax.sgd(0.05, momentum=0.9)
+        state, _ = training.create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 784)), inner)
+        plain = inner.init(state.params)
+        assert (jax.tree_util.tree_structure(state.opt_state)
+                == jax.tree_util.tree_structure(plain))
