@@ -1,0 +1,175 @@
+"""Pallas TPU flash attention — the hot-op kernel for the transformer path.
+
+Blockwise causal attention computed entirely in VMEM with an online softmax
+(running max/sum), so the [T, T] score matrix never touches HBM: per grid
+step a [BLOCK_Q, D] query tile is streamed against K/V tiles with MXU
+matmuls (f32 accumulation). Used by the parallel transformer's single-shard
+attention path (``parallel/transformer.py``) when the dense score tensor
+would exhaust HBM; the sequence-parallel path
+(:func:`horovod_tpu.parallel.ring.ring_attention`) keeps its own blockwise
+accumulation across chips.
+
+Off-TPU (CPU tests) the kernel runs in interpreter mode, bit-matching the
+compiled path's math. `flash_attention` falls back to plain XLA attention
+for shapes the kernel doesn't tile (tiny head_dim or sequences not divisible
+by the block).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                 causal: bool, sm_scale: float):
+    """Grid (bh, qi, kb): one [BLOCK_Q, D] × [BLOCK_K, D] tile pair.
+
+    K/V tiles stream through VMEM (small blocks — no whole-sequence
+    residency); the online-softmax state (acc/m/l) persists in scratch
+    across the kb axis, and the normalized output is written at the last
+    kb step. Above-diagonal tile pairs skip all compute under causal.
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    run = (kb * BLOCK_K <= qi * BLOCK_Q + BLOCK_Q - 1) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                 # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [BQ, BK]
+        if causal:
+            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+            k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_prev = m_ref[:, 0]                             # [BQ]
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = (l_ref[:, 0] * alpha
+                    + jnp.sum(p, axis=-1))[:, None] * jnp.ones_like(l_ref)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new[:, None] * jnp.ones_like(m_ref)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "interpret"))
+def _flash_bhtd(q, k, v, causal: bool, sm_scale: float, interpret: bool):
+    """q/k/v: [BH, T, D] -> [BH, T, D]."""
+    BH, T, D = q.shape
+    grid = (BH, T // BLOCK_Q, T // BLOCK_K)
+    kernel = functools.partial(_attn_kernel, causal=causal,
+                               sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, D),
+                               lambda bh, qi, kb: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, D), jnp.float32),       # acc
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),     # running max
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),     # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# Above roughly this many bytes of [B, H, T, T] f32 scores, the dense XLA
+# path risks HBM exhaustion and the blockwise kernel wins by never
+# materializing them.
+_SCORE_BYTES_CUTOVER = 4 * 1024 ** 3
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    backend: str = "auto",
+                    interpret: Optional[bool] = None):
+    """Multi-head attention: XLA by default, Pallas kernel for long context.
+
+    Args:
+      q, k, v: [B, T, H, D].
+      causal: apply the causal mask.
+      sm_scale: softmax scale (default 1/sqrt(D)).
+      backend: "auto" (XLA unless the score tensor would exceed ~4 GiB —
+        measured on the target platform XLA's fused attention outruns
+        Mosaic until memory becomes the binding constraint), "pallas", or
+        "xla".
+      interpret: force kernel interpreter mode (defaults to True off-TPU).
+
+    The kernel requires T divisible by 128 and D a multiple of 128; other
+    shapes always take the XLA path.
+    """
+    B, T, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = float(D) ** -0.5
+    tilable = (_HAS_PALLAS and T % BLOCK_Q == 0 and T % BLOCK_K == 0
+               and D % 128 == 0)
+    if backend == "auto":
+        score_bytes = 4 * B * H * T * T
+        backend = "pallas" if (tilable
+                               and score_bytes > _SCORE_BYTES_CUTOVER) \
+            else "xla"
+    if backend == "xla" or not tilable:
+        return _xla_attention(q, k, v, causal, sm_scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), causal, sm_scale,
+                      interpret)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _xla_attention(q, k, v, causal, sm_scale):
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        pos = jnp.arange(q.shape[1])
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,25 @@
+"""Multi-axis parallelism: dp/tp/pp/sp/ep over a hybrid mesh.
+
+Net-new TPU capabilities beyond the dp-only reference (SURVEY §2.4):
+ring/Ulysses sequence parallelism for long context, Megatron tensor
+parallelism, GPipe pipeline parallelism, and GShard expert parallelism —
+all as shard_map-native building blocks over `create_hybrid_mesh`.
+"""
+
+from .mesh import AXES, axis_size, create_hybrid_mesh  # noqa: F401
+from .moe import moe_ffn  # noqa: F401
+from .pipeline import gpipe  # noqa: F401
+from .ring import ring_attention, ulysses_attention  # noqa: F401
+from .tp import (  # noqa: F401
+    column_parallel,
+    init_column,
+    init_row,
+    row_parallel,
+)
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    forward,
+    init_params,
+    make_parallel_train_step,
+    param_specs,
+)

@@ -1,0 +1,53 @@
+"""Multi-axis device meshes: dp / tp / pp / sp / ep.
+
+Beyond reference parity (the reference is data-parallel only, SURVEY §2.4);
+these are the TPU-era parallelism axes the framework exposes so long-context
+and large-model training are first-class. A hybrid mesh lays ranks out so
+that the fastest-varying (innermost) axes map to physically close chips —
+tensor/sequence parallelism wants ICI-neighbor bandwidth, data parallelism
+tolerates DCN.
+
+Axis names (canonical across the framework):
+
+- ``dp`` — data parallel (gradient psum; the reference's world axis)
+- ``tp`` — tensor parallel (Megatron-style sharded matmuls)
+- ``pp`` — pipeline parallel (stage-to-stage ppermute)
+- ``sp`` — sequence/context parallel (ring attention / all-to-all)
+- ``ep`` — expert parallel (MoE dispatch over all_to_all)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def create_hybrid_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sp: int = 1,
+                       ep: int = 1,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh over the axes with size > 1 (plus ``dp`` always).
+
+    Axis order is outermost→innermost ``(dp, pp, ep, sp, tp)``: tp/sp vary
+    fastest so they land on ICI-adjacent chips; dp is outermost so its
+    collectives can ride DCN across hosts ("How to Scale Your Model" mesh
+    recipe).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    sizes = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
+    total = math.prod(sizes.values())
+    if total != len(devs):
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {len(devs)}")
+    names = tuple(a for a in AXES if sizes[a] > 1) or ("dp",)
+    shape = tuple(sizes[a] for a in names)
+    return Mesh(np.array(devs).reshape(shape), names)
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)

@@ -1,0 +1,74 @@
+"""Expert parallelism: top-1 gated MoE with all_to_all dispatch over ``ep``.
+
+Net-new TPU capability (absent from the reference). GShard-style layout:
+one expert per ep rank; each chip's tokens are routed by a learned gate,
+packed into a static-capacity dispatch buffer [S, C, D] (XLA needs static
+shapes — overflow tokens beyond capacity drop, standard MoE behavior),
+exchanged with a single ``all_to_all`` so chip e receives every chip's
+tokens for expert e, transformed by the local expert FFN, and returned by
+the inverse ``all_to_all``; gate probabilities weight the combine.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(x, gate_w, w1, w2, *, axis_name: str = "ep",
+            capacity_factor: float = 1.25):
+    """Top-1 MoE feed-forward over tokens sharded across ``axis_name``.
+
+    Args:
+      x: [T_local, D] this chip's tokens.
+      gate_w: [D, E] gate (replicated; E == axis size).
+      w1: [D, F] local expert up-projection; w2: [F, D] down.
+      capacity_factor: per-expert buffer = ceil(T_local/E · factor).
+
+    Returns ([T_local, D], aux_loss) — aux_loss is the load-balancing loss
+    (mean over experts of fraction_routed · mean_gate_prob · E²).
+    """
+    T, D = x.shape
+    E = lax.axis_size(axis_name)
+    C = max(1, int((T / E) * capacity_factor + 0.999))
+
+    logits = x @ gate_w                               # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)               # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # Position of each token within its expert's capacity buffer.
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)        # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot                  # 1-based
+    pos = jnp.sum(pos, axis=-1) - 1                            # [T], -1 pad
+    keep = (pos >= 0) & (pos < C)
+
+    # Pack: dispatch[e, c, :] = token routed to expert e at slot c.
+    dispatch = jnp.zeros((E, C, D), x.dtype)
+    dispatch = dispatch.at[expert, jnp.clip(pos, 0, C - 1)].add(
+        jnp.where(keep[:, None], x, 0))
+
+    # Exchange: chip r sends block e to chip e; receives [E, C, D] where
+    # block s came from chip s.
+    shuffled = lax.all_to_all(dispatch, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+
+    h = jax.nn.gelu(shuffled.reshape(-1, D) @ w1)
+    out = (h @ w2).reshape(E, C, D)
+
+    # Return to senders and unpack.
+    returned = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    combined = returned[expert, jnp.clip(pos, 0, C - 1)]
+    combined = jnp.where(keep[:, None], combined, 0)
+    y = combined * gate[:, None].astype(x.dtype)
+
+    # Load-balance auxiliary loss (Shazeer et al.): encourages uniform
+    # routing; fraction of tokens per expert × mean gate prob per expert.
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac * mean_prob) * E
+    return y, aux

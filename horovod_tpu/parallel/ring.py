@@ -1,0 +1,140 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+Net-new TPU capability (the reference predates long-context work, SURVEY
+§5.7). The sequence is split along the ``sp`` mesh axis; each chip holds a
+[B, T/S, H, D] shard of Q, K, V. K/V blocks rotate around the ring with
+``ppermute`` (one ICI hop per step) while each chip accumulates its queries'
+attention over every block with a numerically stable online softmax
+(flash-attention-style running max / sum) — so the full [T, T] score matrix
+never materializes and memory stays O(T/S · T/S) per step.
+
+The ppermute rotation overlaps with the block computation under XLA's
+scheduler; S steps complete the exact (optionally causal) result, bit-close
+to dense attention (same math, different summation order).
+
+Reference for the pattern: Liu et al., "Ring Attention with Blockwise
+Transformers" (arXiv:2310.01889); implementation is original and
+shard_map/ppermute-native.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, mask, sm_scale):
+    """One (q-block, kv-block) partial: returns (scores_exp, m_blk, pv).
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool (True=keep).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    m_blk = jnp.max(scores, axis=-1)                      # [B, H, Tq]
+    p = jnp.exp(scores - m_blk[..., None])                # [B, H, Tq, Tk]
+    l_blk = jnp.sum(p, axis=-1)                           # [B, H, Tq]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)              # [B, Tq, H, D]
+    return m_blk, l_blk, pv
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Exact multi-head attention with K/V ring rotation over ``axis_name``.
+
+    Args:
+      q, k, v: [B, T_local, H, D] — this chip's sequence shard.
+      axis_name: the sequence-parallel mesh axis (size S).
+      causal: apply a causal mask using *global* positions (each chip's
+        shard occupies rows [rank·T_local, (rank+1)·T_local)).
+      sm_scale: softmax scale; default 1/sqrt(D).
+
+    Returns [B, T_local, H, D]: this chip's rows of the exact attention
+    output over the full sequence.
+    """
+    B, T, H, D = q.shape
+    S = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    rows = rank * T + jnp.arange(T)                       # global q positions
+
+    def step(s, carry):
+        k_cur, v_cur, o, m, l = carry
+        # Block s arrived from rank (rank - s) mod S.
+        src = (rank - s) % S
+        mask = None
+        if causal:
+            cols = src * T + jnp.arange(T)
+            mask = rows[:, None] >= cols[None, :]
+        m_blk, l_blk, pv = _block_attn(
+            q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            mask, sm_scale)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)                        # rescale old accum
+        beta = jnp.exp(m_blk - m_new)                     # rescale new block
+        l_new = l * alpha + l_blk * beta
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + pv * beta.transpose(0, 2, 1)[..., None])
+        # Rotate K/V one hop around the ring (rank i -> i+1).
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, o_new, m_new, l_new
+
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, H, T), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    _, _, o, m, l = lax.fori_loop(0, S, step, (k, v, o0, m0, l0))
+
+    # Rows with no visible keys (can't happen with causal self-attention,
+    # every row sees itself) would have l == 0; guard the division anyway.
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Instead of rotating K/V, one ``all_to_all`` re-shards from
+    sequence-split to head-split, attention runs locally over the FULL
+    sequence with H/S heads per chip, and a second ``all_to_all`` restores
+    sequence sharding. Two collectives total — cheaper than a ring when
+    H ≥ S and the full T×T block fits; the ring wins for very long T.
+
+    Shapes as :func:`ring_attention`; requires H divisible by the axis size.
+    """
+    B, T, H, D = q.shape
+    S = lax.axis_size(axis_name)
+    if H % S != 0:
+        raise ValueError(f"heads {H} not divisible by sp axis {S}")
+
+    # [B, T/S, H, D] -> [B, T, H/S, D]: split heads, gather sequence.
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    scores = jnp.einsum("bqhd,bkhd->bhqk",
+                        qh.astype(jnp.float32), kh.astype(jnp.float32))
+    scores *= (sm_scale if sm_scale is not None else 1.0 / (D ** 0.5))
+    if causal:
+        full_t = T * S
+        pos = jnp.arange(full_t)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh.astype(jnp.float32))
+    return heads_to_seq(out.astype(q.dtype))

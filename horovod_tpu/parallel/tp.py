@@ -1,0 +1,53 @@
+"""Tensor parallelism: Megatron-style sharded matmul pairs over a ``tp`` axis.
+
+Net-new TPU capability (absent from the reference, SURVEY §2.4). The
+canonical pattern keeps activations replicated across tp while weights are
+sharded: a **column-parallel** matmul (out-features sharded, no
+communication) feeds a **row-parallel** matmul (in-features sharded, one
+``psum`` to recombine) — one collective per MLP/attention block, riding ICI.
+
+These are functions over explicit param arrays (already local shards inside
+``shard_map``); ``init_column/init_row`` build the local shard directly from
+the tp rank so no full-size weight ever materializes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_column(rng, d_in: int, d_out: int, axis_name: str = "tp",
+                dtype=jnp.float32):
+    """Local [d_in, d_out/S] shard of a column-parallel weight; each tp rank
+    folds its index into the rng so shards differ but dp/sp replicas agree."""
+    S = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    local = jax.random.fold_in(rng, r)
+    scale = 1.0 / (d_in ** 0.5)
+    return (jax.random.normal(local, (d_in, d_out // S)) * scale).astype(dtype)
+
+
+def init_row(rng, d_in: int, d_out: int, axis_name: str = "tp",
+             dtype=jnp.float32):
+    """Local [d_in/S, d_out] shard of a row-parallel weight."""
+    S = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    local = jax.random.fold_in(rng, r)
+    scale = 1.0 / (d_in ** 0.5)
+    return (jax.random.normal(local, (d_in // S, d_out)) * scale).astype(dtype)
+
+
+def column_parallel(x, w):
+    """[..., d_in] @ [d_in, d_out_local] -> [..., d_out_local]; no comm —
+    the output stays sharded on its feature dim across tp."""
+    return x @ w
+
+
+def row_parallel(x_local, w, axis_name: str = "tp"):
+    """[..., d_in_local] @ [d_in_local, d_out] -> psum -> replicated
+    [..., d_out]: the single collective of the Megatron pair."""
+    return lax.psum(x_local @ w, axis_name)
