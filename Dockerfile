@@ -1,0 +1,22 @@
+# TPU-VM image for horovod_tpu (parity: the reference ships CUDA+NCCL+OpenMPI
+# Dockerfiles; the TPU analog needs only the jax TPU wheel — no MPI, no sshd
+# fan-out, the launcher is in-repo).
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential make \
+    && rm -rf /var/lib/apt/lists/*
+
+# jax[tpu] resolves libtpu on TPU VMs; CPU fallback works everywhere else.
+RUN pip install --no-cache-dir "jax[tpu]" -f \
+        https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir flax optax orbax-checkpoint chex pytest
+
+WORKDIR /opt/horovod_tpu
+COPY . .
+RUN make -C horovod_tpu/coord && pip install --no-cache-dir -e .
+
+# Sanity: the suite runs CPU-only inside the container (reference CI shape).
+# RUN python -m pytest tests/ -q
+
+ENTRYPOINT ["/bin/bash"]

@@ -8,11 +8,17 @@ is the reference's only published absolute throughput: ResNet-101 at
 1656.82 images/sec across 16 Pascal GPUs = 103.55 images/sec/GPU
 (``docs/benchmarks.md:24-54``; see /root/repo/BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default: prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+``--scaling`` (single-controller only): measures throughput at world sizes
+1, 2, 4, ... and the full device count, printing one scaling-efficiency
+JSON line per size (rate_N / (N · rate_1) — the reference's headline
+metric: 90% @ 128 GPUs; north star ≥90% @ v5e-64) followed by the standard
+full-world images/sec/chip line.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -30,36 +36,40 @@ from horovod_tpu import models, training
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16
 
 
-def main() -> None:
+def _bench_config():
     smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
     on_tpu = jax.default_backend() == "tpu"
-
     if smoke or not on_tpu:
-        image, batch_per_chip, warmup, iters = 64, 16, 2, 5
-        depth_cfg = dict(model="cifar20")
-    else:
-        image, batch_per_chip, warmup, iters = 224, 128, 5, 20
-        depth_cfg = dict(model="resnet50")
+        return dict(model="cifar20", image=64, batch_per_chip=16,
+                    warmup=2, iters=5, classes=10)
+    return dict(model="resnet50", image=224, batch_per_chip=128,
+                warmup=5, iters=20, classes=1000)
 
-    hvd.init()
+
+def measure(devices=None, cfg=None) -> float:
+    """Images/sec of the compiled distributed train step over ``devices``
+    (default: all). Returns total (not per-chip) throughput."""
+    cfg = cfg or _bench_config()
+    if hvd.is_initialized():
+        hvd.shutdown()
+    hvd.init(devices=devices)
     n = hvd.size()
-    batch = batch_per_chip * n
+    batch = cfg["batch_per_chip"] * n
+    image, classes = cfg["image"], cfg["classes"]
 
-    if depth_cfg["model"] == "resnet50":
-        model = models.resnet50(num_classes=1000, dtype=jnp.bfloat16,
+    if cfg["model"] == "resnet50":
+        model = models.resnet50(num_classes=classes, dtype=jnp.bfloat16,
                                 axis_name=hvd.AXIS)
-        classes = 1000
     else:
         model = models.cifar_resnet_v1(20, dtype=jnp.float32,
                                        axis_name=hvd.AXIS)
-        classes = 10
 
     x_shape = (batch, image, image, 3)
     # Init from a per-chip-sized sample: flax init runs a real forward pass
     # on one device, so a global-batch sample would OOM at pod scale.
     state, dist_opt = training.create_train_state(
         model, jax.random.PRNGKey(0),
-        jnp.zeros((batch_per_chip,) + x_shape[1:], jnp.float32),
+        jnp.zeros((cfg["batch_per_chip"],) + x_shape[1:], jnp.float32),
         optax.sgd(0.1, momentum=0.9))
     step = training.make_train_step(model, dist_opt)
 
@@ -76,20 +86,21 @@ def main() -> None:
 
     def _shard_labels(idx):
         rng = np.random.RandomState(1 + hash(str(idx)) % 2**31)
-        n = idx[0].stop - idx[0].start if idx[0].start is not None else batch
-        return rng.randint(0, classes, size=(n,))
+        rows = idx[0].stop - idx[0].start if idx[0].start is not None \
+            else batch
+        return rng.randint(0, classes, size=(rows,))
 
     data = (
         jax.make_array_from_callback(x_shape, sharding, _shard_data),
         jax.make_array_from_callback((batch,), sharding, _shard_labels),
     )
 
-    for _ in range(warmup):
+    for _ in range(cfg["warmup"]):
         state, metrics = step(state, data)
     float(metrics["loss"])  # full device->host sync before timing
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for _ in range(cfg["iters"]):
         state, metrics = step(state, data)
     # End the timed region with an explicit host transfer: on experimental
     # backends block_until_ready alone has been observed to return before
@@ -97,11 +108,59 @@ def main() -> None:
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
+    return batch * cfg["iters"] / dt
 
-    img_per_sec = batch * iters / dt
-    per_chip = img_per_sec / n
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--scaling", action="store_true",
+                   help="measure world sizes 1,2,4,... and report "
+                        "scaling efficiency per size")
+    args = p.parse_args()
+    cfg = _bench_config()
+
+    if args.scaling:
+        # Scaling mode is single-controller only: it re-inits the world with
+        # device subsets, which is ill-defined when other processes own part
+        # of the mesh (jax.distributed) or in tpurun env-worlds.
+        from horovod_tpu.utils import config as _hvd_config
+        if jax.process_count() > 1 or _hvd_config.launcher_size(1) > 1:
+            raise SystemExit(
+                "--scaling requires a single-controller world (run without "
+                "tpurun/jax.distributed; one process drives all chips)")
+        devs = jax.devices()
+        sizes = sorted({s for s in (2 ** p for p in range(8))
+                        if s <= len(devs)} | {len(devs)})
+        rate1 = None
+        rate = None
+        for n in sizes:
+            rate = measure(devices=devs[:n], cfg=cfg)
+            if n == 1:
+                rate1 = rate
+            eff = rate / (n * rate1) if rate1 else float("nan")
+            print(json.dumps({
+                "metric": f"{cfg['model']}_scaling_efficiency_{n}chips",
+                "value": round(eff, 4),
+                "unit": "fraction",
+                "vs_baseline": round(eff / 0.90, 3),  # ref: 90% @ 128 GPUs
+                "images_per_sec_total": round(rate, 2),
+            }))
+        # Also emit the standard absolute metric (full world) so parsers
+        # keyed on it always find it.
+        per_chip = rate / len(devs)
+        print(json.dumps({
+            "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
+            "value": round(per_chip, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE,
+                                 3),
+        }))
+        return
+
+    rate = measure(cfg=cfg)
+    per_chip = rate / hvd.size()
     print(json.dumps({
-        "metric": f"{depth_cfg['model']}_synthetic_images_per_sec_per_chip",
+        "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),

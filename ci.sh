@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CI pipeline (parity: reference .travis.yml — build the native core, run the
+# collective test suite under a multi-"rank" world, then shrunken examples
+# end-to-end, .travis.yml:77-108).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== build native coordination core =="
+make -C horovod_tpu/coord
+
+echo "== unit + multi-process test suite (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== shrunken examples end-to-end (integration tests) =="
+run_cpu() {
+  PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 "$@"
+}
+run_cpu python examples/mnist.py
+run_cpu python examples/mnist_advanced.py
+run_cpu python examples/cifar10_cnn.py --epochs 1
+run_cpu python examples/word2vec.py
+run_cpu python examples/imagenet_resnet50.py --epochs 1 --image 32 --batch-per-chip 4 \
+  --ckpt-dir "$(mktemp -d)"
+
+echo "== tpurun launcher smoke (2 ranks, env-world) =="
+python -m horovod_tpu.launcher -np 2 --cpu python tests/launcher_worker.py
+
+echo "== driver contracts =="
+PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python __graft_entry__.py
+HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py
+HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --scaling
+
+echo "CI OK"

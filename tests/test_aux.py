@@ -1,0 +1,124 @@
+"""Auxiliary subsystem tests (SURVEY §5): stall detection warnings and the
+chrome-trace timeline, in both single-controller and coordinated modes."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestStallDetection:
+    def test_warning_lists_tensor_and_ready_ranks(self, tmp_path):
+        """Rank 0 announces a tensor rank 1 never does; the coordinator must
+        print the stalled op and ready ranks within the (shortened) stall
+        window (CheckForStalledTensors parity, mpi_ops.cc:1153-1196)."""
+        port = _free_port()
+        script = textwrap.dedent(f"""
+            import os, sys, threading, time
+            sys.path.insert(0, {ROOT!r})
+            import numpy as np
+            from horovod_tpu.coord.client import CoordClient
+
+            rank = int(os.environ["HVD_RANK"])
+            c = CoordClient(rank, 2, "127.0.0.1", {port})
+            if rank == 0:
+                # Announce on a worker thread; it will stall (rank 1 never
+                # announces this name) until shutdown.
+                t = threading.Thread(
+                    target=lambda: c.collective(
+                        "allreduce", np.ones(3, np.float32), "stalled.op"),
+                    daemon=True)
+                t.start()
+            time.sleep(2.5)   # > HOROVOD_STALL_CHECK_TIME=1
+            c.shutdown()
+        """)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                       HOROVOD_STALL_CHECK_TIME="1")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=120) for p in procs]
+        stderr0 = outs[0][1]
+        assert "stalled.op" in stderr0, stderr0
+        assert "ready ranks: 0" in stderr0, stderr0
+
+
+class TestTimeline:
+    def test_coord_timeline_valid_chrome_trace(self, tmp_path):
+        """HOROVOD_TIMELINE in coordinated mode: the native coordinator
+        writes a parseable chrome trace with negotiation + execute events
+        (timeline.cc parity; docs/timeline.md)."""
+        port = _free_port()
+        tl = str(tmp_path / "timeline.json")
+        script = textwrap.dedent(f"""
+            import os, sys
+            sys.path.insert(0, {ROOT!r})
+            import numpy as np
+            from horovod_tpu.coord.client import CoordClient
+
+            rank = int(os.environ["HVD_RANK"])
+            c = CoordClient(rank, 2, "127.0.0.1", {port})
+            out = c.collective("allreduce", np.ones(4, np.float32), "tl.op")
+            assert np.allclose(np.asarray(out), 2.0)
+            c.shutdown()
+        """)
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                       JAX_PLATFORMS="cpu", HOROVOD_TIMELINE=tl)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", script], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out
+        events = json.load(open(tl))
+        names = {e.get("name") for e in events}
+        assert "NEGOTIATE" in names, names
+        assert "EXECUTE" in names, names
+        # Per-tensor "process" metadata rows (timeline.cc model).
+        assert any(e.get("ph") == "M" for e in events)
+        assert any("rank_0_ready" == e.get("name") for e in events)
+        assert any("rank_1_ready" == e.get("name") for e in events)
+
+    def test_single_controller_timeline(self, tmp_path):
+        """HOROVOD_TIMELINE single-controller: the Python writer records
+        eager collectives."""
+        tl = str(tmp_path / "tl.json")
+        script = textwrap.dedent(f"""
+            import os, sys
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            os.environ["HOROVOD_TIMELINE"] = {tl!r}
+            sys.path.insert(0, {ROOT!r})
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+            hvd.init()
+            hvd.allreduce(jnp.ones(3), name="tl_single")
+            hvd.shutdown()
+        """)
+        r = subprocess.run([sys.executable, "-c", script],
+                           env=dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu"),
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stdout + r.stderr
+        events = json.load(open(tl))
+        assert any("HorovodAllreduce_tl_single" in str(e.get("args", {}))
+                   or "tl_single" in str(e) for e in events), events[:5]
