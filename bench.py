@@ -124,7 +124,11 @@ def main() -> None:
         # device subsets, which is ill-defined when other processes own part
         # of the mesh (jax.distributed) or in tpurun env-worlds.
         from horovod_tpu.utils import config as _hvd_config
-        if jax.process_count() > 1 or _hvd_config.launcher_size(1) > 1:
+        # Probe the ENV, not jax.process_count(): touching the backend here
+        # would both defeat the check (count is 1 before distributed init)
+        # and block a later jax.distributed initialization.
+        if _hvd_config.launcher_size(1) > 1 \
+                or os.environ.get("JAX_COORDINATOR_ADDRESS"):
             raise SystemExit(
                 "--scaling requires a single-controller world (run without "
                 "tpurun/jax.distributed; one process drives all chips)")

@@ -198,6 +198,45 @@ class LearningRateScheduleCallback(Callback):
             logs["lr"] = self._get_lr()
 
 
+class ReduceLROnPlateauCallback(Callback):
+    """Reduce LR when a monitored metric plateaus — the Keras callback the
+    reference's advanced example stacks AFTER ``MetricAverageCallback``
+    (``keras_mnist_advanced.py:87-95``: metrics must be globally averaged
+    first so every rank takes the same LR decision)."""
+
+    def __init__(self, monitor: str = "val_loss", factor: float = 0.1,
+                 patience: int = 10, min_lr: float = 0.0,
+                 mode: str = "min"):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.min_lr = min_lr
+        self.mode = mode
+        self.best: Optional[float] = None
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        current = float(logs[self.monitor])
+        improved = (self.best is None
+                    or (self.mode == "min" and current < self.best)
+                    or (self.mode == "max" and current > self.best))
+        if improved:
+            self.best = current
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            lr = get_hyperparam(self.trainer.state.opt_state,
+                                "learning_rate")
+            new_lr = max(lr * self.factor, self.min_lr)
+            if new_lr < lr:
+                self.trainer.state.opt_state = set_hyperparam(
+                    self.trainer.state.opt_state, "learning_rate", new_lr)
+            self.wait = 0
+
+
 class LearningRateWarmupCallback(LearningRateScheduleCallback):
     """Gradual warmup ``lr/size → lr`` over ``warmup_epochs``
     (parity: ``callbacks.py:202-259``; Goyal et al. 1706.02677)::

@@ -65,11 +65,27 @@ def _chips_per_host() -> int:
 
 def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
            jax_distributed: bool = False, cpu: bool = False,
+           node_rank: int = 0, nnodes: int = 1,
+           coordinator: Optional[str] = None,
            extra_env: Optional[dict] = None) -> int:
-    """Spawn ``np_`` ranks of ``command`` with the world env wired up.
-    Returns the first nonzero exit code (0 if all succeeded)."""
-    port = coord_port or _free_port()
-    jd_port = _free_port() if jax_distributed else None
+    """Spawn ``np_`` local ranks of ``command`` with the world env wired up.
+
+    Multi-host: run tpurun on every host with the same ``--coordinator
+    host0:port`` and ``--nnodes N``, giving each host its ``--node-rank``
+    (the role of ``mpirun -H host1:4,host2:4``, reference
+    ``docs/running.md:15-45``). World size = nnodes · np_; this host's ranks
+    are ``node_rank·np_ .. node_rank·np_+np_-1``.
+
+    Returns the first nonzero exit code (0 if all succeeded).
+    """
+    world = nnodes * np_
+    if coordinator:
+        coord_host, _, cport = coordinator.partition(":")
+        coord_addr = f"{coord_host}:{cport or 29521}"
+        jd_addr = f"{coord_host}:{int(cport or 29521) + 1}"
+    else:
+        coord_addr = f"127.0.0.1:{coord_port or _free_port()}"
+        jd_addr = f"127.0.0.1:{_free_port()}" if jax_distributed else None
     procs = []
 
     def _terminate(signum, frame):
@@ -78,21 +94,22 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
     old = signal.signal(signal.SIGTERM, _terminate)
 
     try:
-        for rank in range(np_):
+        for local_rank in range(np_):
+            rank = node_rank * np_ + local_rank
             env = dict(os.environ)
             env.update(extra_env or {})
             env["HVD_RANK"] = str(rank)
-            env["HVD_SIZE"] = str(np_)
-            env["HVD_LOCAL_RANK"] = str(rank % max(1, _chips_per_host()
-                                                   if not cpu else np_))
-            env["HVD_COORD_ADDR"] = f"127.0.0.1:{port}"
+            env["HVD_SIZE"] = str(world)
+            env["HVD_LOCAL_RANK"] = str(
+                local_rank % max(1, _chips_per_host() if not cpu else np_))
+            env["HVD_COORD_ADDR"] = coord_addr
             if cpu:
                 # CPU testing mode (reference CI: mpirun -np 2 on localhost
                 # CPU-only, .travis.yml:84-91).
                 env["JAX_PLATFORMS"] = "cpu"
             if jax_distributed:
-                env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{jd_port}"
-                env["JAX_NUM_PROCESSES"] = str(np_)
+                env["JAX_COORDINATOR_ADDRESS"] = jd_addr
+                env["JAX_NUM_PROCESSES"] = str(world)
                 env["JAX_PROCESS_ID"] = str(rank)
             procs.append(subprocess.Popen(command, env=env))
         rc = 0
@@ -121,13 +138,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="also form a jax.distributed world so compiled "
                              "collectives span processes")
     parser.add_argument("--coord-port", type=int, default=None)
+    parser.add_argument("--node-rank", type=int, default=0,
+                        help="this host's index among --nnodes hosts")
+    parser.add_argument("--nnodes", type=int, default=1,
+                        help="total hosts in the job (world = nnodes * np)")
+    parser.add_argument("--coordinator", default=None,
+                        help="host0:port rendezvous shared by all hosts "
+                             "(required when nnodes > 1)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the command to run, e.g. python train.py")
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
+    if args.nnodes > 1 and not args.coordinator:
+        parser.error("--nnodes > 1 requires --coordinator host0:port")
     return launch(args.np, args.command, coord_port=args.coord_port,
-                  jax_distributed=args.jax_distributed, cpu=args.cpu)
+                  jax_distributed=args.jax_distributed, cpu=args.cpu,
+                  node_rank=args.node_rank, nnodes=args.nnodes,
+                  coordinator=args.coordinator)
 
 
 if __name__ == "__main__":

@@ -100,6 +100,7 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
             return _world
         _generation += 1
 
+        _maybe_init_jax_distributed()
         devs = list(devices) if devices is not None else list(jax.devices())
         mesh = Mesh(np.array(devs), (AXIS,))
         size = len(devs)
@@ -170,6 +171,45 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
             env_world=env_world,
         )
         return _world
+
+
+def _maybe_init_jax_distributed() -> None:
+    """Form the jax.distributed world from tpurun's env when requested.
+
+    tpurun --jax-distributed exports JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID; ``jax.distributed.initialize``
+    needs them passed explicitly. Idempotent; silently skipped if the
+    world is already up or the env is absent.
+    """
+    import os
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if not (addr and nproc and pid):
+        return
+    # NB: do NOT probe jax.process_count() here — it would initialize the
+    # backend single-process and make distributed init impossible.
+    if jax.distributed.is_initialized():
+        return
+    try:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=int(nproc),
+                                   process_id=int(pid))
+    except RuntimeError as e:
+        # Only tolerate "backend already initialized" (the user touched
+        # devices before init() — distributed formation is impossible but
+        # single-process still works). A coordinator-connection failure
+        # must NOT be swallowed: proceeding would silently train without
+        # gradient exchange.
+        if "already" in str(e).lower():
+            import warnings
+            warnings.warn(
+                "jax backend was initialized before hvd.init(); the "
+                "jax.distributed world requested by the launcher could not "
+                "be formed — compiled collectives will not span processes "
+                f"({e})")
+        else:
+            raise
 
 
 def _infer_local_rank(devs: Sequence[jax.Device], process_index: int) -> int:

@@ -27,11 +27,27 @@ def main():
         expected = sum(r + 1 for r in range(size))
         assert np.allclose(out, expected), (out, expected)
 
-        # Allreduce int64 + bfloat16 dtype coverage.
+        # Dtype coverage: int64, uint8, bool, bfloat16 (reference sweeps
+        # 9 dtypes, mpi_ops.cc:476-510; we add bf16).
         xi = np.arange(6, dtype=np.int64) * (rank + 1)
         outi = np.asarray(client.collective("allreduce", xi, "t.allreduce.i64"))
         assert np.array_equal(outi, np.arange(6) * sum(
             r + 1 for r in range(size))), outi
+
+        xu = np.full((3,), 2, np.uint8)
+        outu = np.asarray(client.collective("allreduce", xu, "t.allreduce.u8"))
+        assert np.array_equal(outu, np.full((3,), 2 * size, np.uint8)), outu
+
+        xb = np.array([rank == 0, False, True])
+        outb = np.asarray(client.collective("allreduce", xb, "t.allreduce.b"))
+        assert np.array_equal(outb, [True, False, True]), outb  # OR semantics
+
+        import ml_dtypes
+        xf = np.asarray([1.5, -2.0, 0.25], ml_dtypes.bfloat16)
+        outf = np.asarray(client.collective("allreduce", xf,
+                                            "t.allreduce.bf16"))
+        assert np.allclose(outf.astype(np.float32),
+                           np.asarray([1.5, -2.0, 0.25]) * size), outf
 
         # Ragged allgather: rank r contributes r+1 rows of constant r.
         rows = np.full((rank + 1, 2), float(rank), np.float32)

@@ -102,6 +102,23 @@ class TestWarmup:
         assert history[1]["lr"] == pytest.approx(0.1)   # epoch 1: 1.0 * 0.1
 
 
+class TestReduceLROnPlateau:
+    def test_reduces_after_patience(self):
+        _, state, step = _mnist_setup(lr=1.0)
+        t = trainer_mod.Trainer(step, state, verbose=False)
+        cb = callbacks.ReduceLROnPlateauCallback(
+            monitor="val_loss", factor=0.5, patience=2)
+        cb.set_trainer(t)
+        cb.on_epoch_end(0, {"val_loss": 1.0})   # best
+        cb.on_epoch_end(1, {"val_loss": 1.2})   # wait 1
+        cb.on_epoch_end(2, {"val_loss": 1.1})   # wait 2 -> reduce
+        assert callbacks.get_hyperparam(
+            t.state.opt_state, "learning_rate") == pytest.approx(0.5)
+        cb.on_epoch_end(3, {"val_loss": 0.5})   # new best, no change
+        assert callbacks.get_hyperparam(
+            t.state.opt_state, "learning_rate") == pytest.approx(0.5)
+
+
 class TestMetricAverage:
     def test_scalar_metrics_averaged(self):
         cb = callbacks.MetricAverageCallback()

@@ -20,3 +20,49 @@ def test_tpurun_three_ranks():
     assert out.returncode == 0, out.stdout + out.stderr
     for r in range(3):
         assert f"rank {r}/3: LAUNCHER OK" in out.stdout, out.stdout
+
+
+def test_tpurun_multi_node_simulated():
+    """Two tpurun invocations with --nnodes 2 (localhost standing in for
+    two hosts) must form ONE world of 2 ranks over the shared coordinator
+    (the mpirun -H host1,host2 analog)."""
+    import socket
+    import re
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.launcher", "-np", "1",
+             "--cpu", "--nnodes", "2", "--node-rank", str(i),
+             "--coordinator", f"127.0.0.1:{port}", "--jax-distributed",
+             sys.executable, os.path.join(HERE, "jd_worker.py")],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    found = re.findall(r"rank (\d+): JD OK", "".join(outs))
+    assert sorted(found) == ["0", "1"], outs
+
+
+def test_tpurun_jax_distributed():
+    """--jax-distributed: compiled collectives span processes (global mesh
+    + Gloo on CPU); the two ranks must train in lockstep."""
+    env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")  # 1 device/proc
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launcher", "-np", "2", "--cpu",
+         "--jax-distributed",
+         sys.executable, os.path.join(HERE, "jd_worker.py")],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    # Concurrent writers can interleave on one line; match by pattern.
+    import re
+    found = re.findall(r"rank (\d+): JD OK checksum ([0-9.]+)", out.stdout)
+    assert len(found) == 2, out.stdout
+    assert {r for r, _ in found} == {"0", "1"}, found
+    assert len({c for _, c in found}) == 1, f"replicas diverged: {found}"
