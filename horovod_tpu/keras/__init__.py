@@ -1,0 +1,181 @@
+"""Keras adapter — parity with ``horovod/keras/__init__.py`` for Keras 3.
+
+A reference user writes ``import horovod.keras as hvd``; this module gives
+the same surface over the TPU-native core:
+
+* :func:`DistributedOptimizer` — a **dynamically created subclass of the
+  user's optimizer class** (keeping the class name so checkpoints restore
+  without this framework installed — the reference's trick,
+  ``keras/__init__.py:81-87``) whose ``apply_gradients`` averages gradients
+  across ranks first (``keras/__init__.py:41-63`` overrode
+  ``get_gradients``; Keras 3 hooks ``apply_gradients``).
+* eager ``allreduce/allgather/broadcast(value)`` helpers
+  (``keras/__init__.py:90-144`` ran them through ``K.get_session().run``;
+  here they dispatch the framework's eager plane directly).
+* ``broadcast_global_variables(model, root_rank)`` — weight sync from rank
+  0 into a built Keras model.
+* re-exported ``init/size/rank/local_rank`` process API.
+
+Works with any Keras 3 backend (tensorflow / jax / torch): values cross
+into the collective plane via numpy and return as numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import runtime
+from ..ops.collectives import Op
+from ..ops.collectives import allgather as _allgather
+from ..ops.collectives import allreduce as _allreduce
+from ..ops.collectives import broadcast as _broadcast
+from ..runtime import (  # noqa: F401  (re-exports, reference parity)
+    init,
+    is_initialized,
+    local_rank,
+    process_count,
+    process_index,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def allreduce(value, average: bool = True, name: Optional[str] = None):
+    """Eager allreduce of a value/array; returns numpy
+    (parity: ``keras/__init__.py:117-126``)."""
+    return np.asarray(_allreduce(np.asarray(value), average=average,
+                                 name=name))
+
+
+def allgather(value, name: Optional[str] = None):
+    """Eager allgather along dim 0; returns numpy
+    (parity: ``keras/__init__.py:129-136``)."""
+    return np.asarray(_allgather(np.asarray(value), name=name))
+
+
+def broadcast(value, root_rank: int = 0, name: Optional[str] = None):
+    """Eager broadcast from ``root_rank``; returns numpy
+    (parity: ``keras/__init__.py:139-144``)."""
+    return np.asarray(_broadcast(np.asarray(value), root_rank=root_rank,
+                                 name=name))
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Sync a built Keras model's weights (and optimizer variables, if
+    built) from ``root_rank`` (parity: ``keras/__init__.py:90-96`` +
+    ``BroadcastGlobalVariablesCallback``)."""
+    for v in model.weights:
+        v.assign(broadcast(np.asarray(v), root_rank,
+                           name=f"bcast.{v.path if hasattr(v, 'path') else v.name}"))
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "built", False):
+        for v in opt.variables:
+            v.assign(broadcast(np.asarray(v), root_rank,
+                               name=f"bcast.opt.{getattr(v, 'path', v.name)}"))
+
+
+def DistributedOptimizer(optimizer, *, average: bool = True,
+                         name: Optional[str] = None):
+    """Wrap a Keras 3 optimizer so gradients are averaged across ranks
+    before being applied.
+
+    Returns an instance of a dynamically created subclass of
+    ``type(optimizer)`` with the same class name, so saved configs/
+    checkpoints deserialize with plain Keras when this framework is absent
+    (reference: ``keras/__init__.py:81-87``). A no-op wrapper when
+    ``size() == 1``.
+    """
+    import keras
+
+    cls_name = optimizer.__class__.__name__
+
+    class _Distributed(optimizer.__class__):
+        _hvd_average = average
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            if runtime.is_initialized() and runtime.size() > 1:
+                grads_and_vars = [
+                    (self._hvd_allreduce_grad(g, v), v)
+                    for g, v in grads_and_vars
+                ]
+            return super().apply_gradients(grads_and_vars, *args, **kwargs)
+
+        def _hvd_allreduce_grad(self, grad, var):
+            if grad is None:
+                return None
+            op_name = f"grad.{getattr(var, 'path', var.name)}"
+
+            def _reduce_np(g_np):
+                return allreduce(np.asarray(g_np),
+                                 average=self._hvd_average, name=op_name)
+
+            # Keras compiles train steps per backend; bridge the collective
+            # through the backend's host-callback mechanism so it works
+            # inside tf.function / jax.jit, and directly when eager.
+            backend = keras.backend.backend()
+            if backend == "tensorflow":
+                import tensorflow as tf
+                if not tf.executing_eagerly():  # inside tf.function
+                    out = tf.py_function(
+                        lambda g: tf.constant(_reduce_np(g.numpy())),
+                        [grad], Tout=grad.dtype)
+                    out.set_shape(grad.shape)
+                    return out
+            elif backend == "jax":
+                import jax as _jax
+                import jax.core as _jcore
+                if isinstance(grad, _jcore.Tracer):  # inside jit
+                    return _jax.pure_callback(
+                        _reduce_np,
+                        _jax.ShapeDtypeStruct(grad.shape, grad.dtype),
+                        grad)
+            out = _reduce_np(keras.ops.convert_to_numpy(grad))
+            return keras.ops.convert_to_tensor(out, dtype=grad.dtype)
+
+    _Distributed.__name__ = cls_name
+    _Distributed.__qualname__ = cls_name
+
+    config = optimizer.get_config()
+    return _Distributed.from_config(config)
+
+
+class BroadcastGlobalVariablesCallback:
+    """Keras callback: broadcast model + optimizer state from ``root_rank``
+    at train begin (parity: ``horovod/keras/callbacks.py:8-34``)."""
+
+    def __new__(cls, root_rank: int = 0):
+        import keras
+
+        class _CB(keras.callbacks.Callback):
+            def __init__(self, root):
+                super().__init__()
+                self.root_rank = root
+
+            def on_train_begin(self, logs=None):
+                broadcast_global_variables(self.model, self.root_rank)
+
+        return _CB(root_rank)
+
+
+class MetricAverageCallback:
+    """Keras callback: average epoch-end metrics over ranks (parity:
+    ``horovod/keras/callbacks.py:37-87``); place before callbacks that
+    consume metrics (ReduceLROnPlateau, loggers)."""
+
+    def __new__(cls):
+        import keras
+
+        class _CB(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if not logs:
+                    return
+                for k, v in list(logs.items()):
+                    if isinstance(v, (int, float, np.floating, np.integer)):
+                        logs[k] = float(allreduce(
+                            np.float32(v), average=True,
+                            name=f"metric.{k}"))
+
+        return _CB()
