@@ -75,6 +75,29 @@ def measure(devices=None, cfg=None) -> float:
 
     # Materialize only local shards (a host-side global batch would be
     # multiple GB at pod scale).
+    if hvd.world().env_world:
+        # Independent process per chip: build just this rank's slice (the
+        # shard_batch split), not the global batch — otherwise every rank
+        # trains on all N shards and throughput is over-reported N×.
+        r = hvd.rank()
+        rng = np.random.RandomState(r)
+        local = (cfg["batch_per_chip"],) + x_shape[1:]
+        data = (
+            jnp.asarray(rng.standard_normal(local).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes,
+                                    size=(cfg["batch_per_chip"],))),
+        )
+        for _ in range(cfg["warmup"]):
+            state, metrics = step(state, data)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(cfg["iters"]):
+            state, metrics = step(state, data)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss), final_loss
+        return batch * cfg["iters"] / dt
+
     from jax.sharding import NamedSharding, PartitionSpec as P
     sharding = NamedSharding(hvd.mesh(), P(hvd.AXIS))
 

@@ -17,6 +17,7 @@ run_cpu() {
   XLA_FLAGS=--xla_force_host_platform_device_count=8 "$@"
 }
 run_cpu python examples/mnist.py
+run_cpu python examples/mnist_estimator.py --steps 32
 run_cpu python examples/mnist_advanced.py
 run_cpu python examples/cifar10_cnn.py --epochs 1
 run_cpu python examples/word2vec.py

@@ -1,0 +1,68 @@
+"""MNIST with the Estimator/hook integration — parity with
+``examples/tensorflow_mnist_estimator.py`` (reference): model built behind
+an Estimator, training driven by hooks (broadcast, stop-at-step, logging),
+rank-0-only ``model_dir`` checkpointing, allreduced final eval.
+
+Run single-controller (all local chips form the world):
+    python examples/mnist_estimator.py
+or one process per chip:
+    tpurun -np 4 python examples/mnist_estimator.py
+"""
+
+import argparse
+
+import jax.numpy as jnp
+
+import common  # noqa: F401  (sys.path bootstrap)
+import horovod_tpu as hvd
+from horovod_tpu import models, training
+from horovod_tpu.callbacks import hyper_sgd
+from horovod_tpu.hooks import (BroadcastGlobalVariablesHook, Estimator,
+                               LoggingHook)
+
+from common import load_mnist, batches
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200,
+                   help="total optimizer steps across the world")
+    args = p.parse_args()
+
+    # 1. Initialize the world (tensorflow_mnist_estimator.py:155).
+    hvd.init()
+
+    (x_train, y_train), (x_test, y_test) = load_mnist()
+    global_batch = 64 * hvd.size()
+
+    # 2. Rank-0-only model_dir (tensorflow_mnist_estimator.py:145-147:
+    #    "save checkpoints only on worker 0 to prevent corruption").
+    model_dir = "/tmp/hvd_mnist_estimator" if hvd.rank() == 0 else None
+
+    # 3. LR scaled by world size (tensorflow_mnist_estimator.py:120).
+    est = Estimator(
+        models.MnistCNN(),
+        hyper_sgd(0.05 * hvd.size(), momentum=0.9),
+        model_dir=model_dir,
+        sample_input=jnp.zeros((2, 784)),
+        metrics_fn=lambda lg, lb: {"accuracy": training.accuracy(lg, lb)},
+    )
+
+    # 4. Hooks: broadcast initial state from rank 0 + rank-0 logging
+    #    (tensorflow_mnist_estimator.py:160-173; StopAtStepHook comes from
+    #    steps=).
+    est.train(
+        batches(x_train, y_train, global_batch),
+        steps=max(args.steps // hvd.size(), 1),
+        hooks=[BroadcastGlobalVariablesHook(0), LoggingHook(every_n_steps=20)],
+    )
+
+    # 5. Globally averaged eval (tensorflow_mnist_estimator.py:186-190).
+    metrics = est.evaluate(batches(x_test, y_test, global_batch,
+                                   shuffle=False))
+    if hvd.rank() == 0:
+        print("eval:", {k: round(v, 4) for k, v in metrics.items()})
+
+
+if __name__ == "__main__":
+    main()

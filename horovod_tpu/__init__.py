@@ -40,6 +40,10 @@ from .ops.collectives import (  # noqa: F401
     alltoall,
     reducescatter,
     grouped_allreduce,
+    allreduce_async_,
+    allgather_async_,
+    broadcast_async_,
+    synchronize,
 )
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .optimizer import (  # noqa: F401
@@ -50,6 +54,8 @@ from .optimizer import (  # noqa: F401
     broadcast_optimizer_state,
 )
 from . import callbacks  # noqa: F401
+from . import hooks  # noqa: F401
+from .hooks import BroadcastGlobalVariablesHook  # noqa: F401
 from . import models  # noqa: F401
 from . import training  # noqa: F401
 from .trainer import (  # noqa: F401

@@ -27,7 +27,8 @@ import numpy as np
 from ..exceptions import FailedPreconditionError, TransportError
 from ..utils import config as _config
 
-_REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2}
+_REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
+              "alltoall": 3, "reducescatter": 4}
 
 # numpy dtype -> wire enum (coordinator.cc DType; the reference's nine dtypes
 # of mpi_message.h:26-36 plus bfloat16).
@@ -62,16 +63,24 @@ def _build_and_load() -> ctypes.CDLL:
     lib.hvdcoord_init.restype = ctypes.c_int
     lib.hvdcoord_init.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p]
-    lib.hvdcoord_run.restype = ctypes.c_int
-    lib.hvdcoord_run.argtypes = [
+        ctypes.c_longlong, ctypes.c_double, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int]
+    lib.hvdcoord_submit.restype = ctypes.c_int
+    lib.hvdcoord_submit.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+    lib.hvdcoord_wait.restype = ctypes.c_int
+    lib.hvdcoord_wait.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_char_p, ctypes.c_int]
     lib.hvdcoord_free.argtypes = [ctypes.c_void_p]
     lib.hvdcoord_shutdown.restype = None
+    lib.hvdcoord_responses_received.restype = ctypes.c_longlong
+    lib.hvdcoord_responses_received.argtypes = []
+    lib.hvdcoord_ops_completed.restype = ctypes.c_longlong
+    lib.hvdcoord_ops_completed.argtypes = []
     return lib
 
 
@@ -84,15 +93,22 @@ class CoordClient:
         self.rank = rank
         self.size = size
         tl_path = _config.timeline_path() if rank == 0 else None
+        err = ctypes.create_string_buffer(1024)
         rc = self._lib.hvdcoord_init(
             rank, size, host.encode(), port,
             _config.fusion_threshold_bytes(),
             _config.stall_warning_secs(),
-            tl_path.encode() if tl_path else None)
+            tl_path.encode() if tl_path else None, err, len(err))
         if rc != 0:
+            detail = err.value.decode() or f"rc={rc}"
             raise TransportError(
                 f"coordination plane init failed (rank {rank}, "
-                f"{host}:{port}, rc={rc})")
+                f"{host}:{port}): {detail}")
+        # Names currently announced-but-unwaited by THIS rank. The
+        # coordinator drops duplicate same-rank announcements of an
+        # in-flight name (Ingest), so a second submit under the same name
+        # would wait forever; fail fast here instead.
+        self._inflight: set = set()
         # The coordinator (not Python) writes the timeline in coord mode.
         self.timeline = None
 
@@ -115,38 +131,69 @@ class CoordClient:
         (``horovod/keras/__init__.py:90-144``); errors surface as
         FailedPreconditionError (``mpi_ops.cc:1141-1148``).
         """
-        import jax.numpy as jnp
+        return self.wait(self.submit(kind, x, name, op=op,
+                                     root_rank=root_rank))
+
+    def submit(self, kind: str, x, name: str, *, op=None,
+               root_rank=0) -> "CoordHandle":
+        """Non-blocking announce+send (the reference's ``ComputeAsync`` +
+        ``EnqueueTensor*`` model, ``mpi_ops.cc:1752-1772``): many submits can
+        be in flight at once, which is what feeds coordinator-side response
+        fusion. Complete with :meth:`wait`."""
         from ..ops.collectives import Op
 
         arr = np.asarray(x)
         average = False
-        if kind == "allreduce":
-            if op is not None and op not in (Op.SUM, Op.AVERAGE):
-                raise NotImplementedError(
-                    f"host coordination plane supports SUM/AVERAGE only "
-                    f"(reference parity); got {op}")
-            average = op is Op.AVERAGE
+        red_op = 0
+        if kind in ("allreduce", "reducescatter"):
+            resolved = op if op is not None else Op.SUM
+            average = resolved is Op.AVERAGE
+            red_op = {Op.SUM: 0, Op.AVERAGE: 0, Op.MIN: 1, Op.MAX: 2,
+                      Op.PRODUCT: 3}[resolved]
         dtype_name = arr.dtype.name
         if dtype_name not in _DTYPES:
             raise TypeError(f"unsupported dtype {dtype_name} for eager "
                             f"coordination-plane collective")
 
+        if name in self._inflight:
+            raise ValueError(
+                f"tensor name {name!r} is already in flight on rank "
+                f"{self.rank}; synchronize() the first handle before "
+                f"reusing the name (or pass name=None for auto-naming)")
+
         send_payload = not (kind == "broadcast" and self.rank != root_rank)
         data = np.ascontiguousarray(arr) if send_payload else None
 
         shape = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
+        err = ctypes.create_string_buffer(4096)
+        rc = self._lib.hvdcoord_submit(
+            name.encode(), _REQ_TYPES[kind], _DTYPES[dtype_name], red_op,
+            root_rank, arr.ndim, shape,
+            data.ctypes.data if data is not None else None,
+            data.nbytes if data is not None else 0, err, len(err))
+        if rc != 0:
+            raise TransportError(err.value.decode())
+        self._inflight.add(name)
+        return CoordHandle(self, kind, name, tuple(arr.shape), arr.dtype,
+                           average)
+
+    def wait(self, handle: "CoordHandle"):
+        """Block until ``handle``'s collective completes; returns the result
+        (out-of-order safe — any in-flight handle may be waited first)."""
+        import jax.numpy as jnp
+
+        if handle._result is not None:
+            return handle._result
         out = ctypes.c_void_p()
         out_nbytes = ctypes.c_longlong()
         sizes = (ctypes.c_longlong * self.size)()
         err = ctypes.create_string_buffer(4096)
-
-        rc = self._lib.hvdcoord_run(
-            name.encode(), _REQ_TYPES[kind], _DTYPES[dtype_name],
-            root_rank, arr.ndim, shape,
-            data.ctypes.data if data is not None else None,
-            data.nbytes if data is not None else 0,
-            ctypes.byref(out), ctypes.byref(out_nbytes), sizes, err,
-            len(err))
+        try:
+            rc = self._lib.hvdcoord_wait(
+                handle.name.encode(), ctypes.byref(out),
+                ctypes.byref(out_nbytes), sizes, err, len(err))
+        finally:
+            self._inflight.discard(handle.name)
         if rc == 1:
             raise FailedPreconditionError(err.value.decode())
         if rc != 0:
@@ -154,20 +201,55 @@ class CoordClient:
 
         raw = ctypes.string_at(out.value, out_nbytes.value)
         self._lib.hvdcoord_free(out)
-        result = np.frombuffer(raw, dtype=arr.dtype)
+        result = np.frombuffer(raw, dtype=handle.dtype)
 
+        kind, shape = handle.kind, handle.shape
         if kind == "allreduce":
-            result = result.reshape(arr.shape)
-            if average:
-                result = (result // self.size).astype(arr.dtype) \
-                    if np.issubdtype(arr.dtype, np.integer) \
-                    else result / self.size
+            result = result.reshape(shape)
+            if handle.average:
+                # True division; integers promote to float exactly as the
+                # compiled plane's lax.pmean does (jnp.asarray then applies
+                # the session's x64 policy, so both planes agree bit-for-bit
+                # on dtype).
+                result = result / self.size
         elif kind == "allgather":
             total_rows = int(sum(sizes[i] for i in range(self.size)))
-            result = result.reshape((total_rows,) + tuple(arr.shape[1:]))
+            result = result.reshape((total_rows,) + tuple(shape[1:]))
+        elif kind == "alltoall":
+            result = result.reshape(shape)
+        elif kind == "reducescatter":
+            result = result.reshape((shape[0] // self.size,)
+                                    + tuple(shape[1:]))
+            if handle.average:
+                result = result / self.size
         else:  # broadcast
-            result = result.reshape(arr.shape)
-        return jnp.asarray(result)
+            result = result.reshape(shape)
+        handle._result = jnp.asarray(result)
+        return handle._result
+
+    # -- fusion observability (fused-path test support, the analog of the
+    # reference's deliberately-fused mpi_ops_test.py:116-148) ---------------
+    def responses_received(self) -> int:
+        return int(self._lib.hvdcoord_responses_received())
+
+    def ops_completed(self) -> int:
+        return int(self._lib.hvdcoord_ops_completed())
 
     def shutdown(self):
         self._lib.hvdcoord_shutdown()
+
+
+class CoordHandle:
+    """In-flight eager collective (async API, reference ``ComputeAsync``
+    callback model). Obtain via :meth:`CoordClient.submit`; redeem with
+    :meth:`CoordClient.wait` (or ``horovod_tpu.synchronize``)."""
+
+    def __init__(self, client: CoordClient, kind: str, name: str,
+                 shape: tuple, dtype, average: bool):
+        self.client = client
+        self.kind = kind
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+        self.average = average
+        self._result = None

@@ -50,14 +50,39 @@ namespace hvdcoord {
 // Protocol constants (values are wire ABI; keep stable).
 // ---------------------------------------------------------------------------
 
-enum class ReqType : uint8_t { kAllreduce = 0, kAllgather = 1, kBroadcast = 2 };
+enum class ReqType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  // TPU-era extras (compiled-plane parity: ops/collectives.py alltoall /
+  // reducescatter; not in reference v0.11.2).
+  kAlltoall = 3,
+  kReducescatter = 4,
+};
 enum class RespType : uint8_t {
   kAllreduce = 0,
   kAllgather = 1,
   kBroadcast = 2,
   kError = 3,
   kShutdown = 4,
+  kAlltoall = 5,
+  kReducescatter = 6,
 };
+
+// Reduction op for allreduce/reducescatter. The reference supports SUM only
+// (MPI_SUM, mpi_ops.cc:1061-1064); MIN/MAX/PROD close the asymmetry with the
+// compiled plane's Op enum (average = SUM + client-side divide).
+enum class RedOp : uint8_t { kSum = 0, kMin = 1, kMax = 2, kProd = 3 };
+
+const char* RedOpName(RedOp o) {
+  switch (o) {
+    case RedOp::kSum: return "SUM";
+    case RedOp::kMin: return "MIN";
+    case RedOp::kMax: return "MAX";
+    case RedOp::kProd: return "PRODUCT";
+  }
+  return "UNKNOWN";
+}
 
 // Dtypes: the reference's nine (mpi_message.h:26-36) plus bfloat16 (TPU era).
 enum class DType : uint8_t {
@@ -86,6 +111,8 @@ const char* ReqTypeName(ReqType t) {
     case ReqType::kAllreduce: return "ALLREDUCE";
     case ReqType::kAllgather: return "ALLGATHER";
     case ReqType::kBroadcast: return "BROADCAST";
+    case ReqType::kAlltoall: return "ALLTOALL";
+    case ReqType::kReducescatter: return "REDUCESCATTER";
   }
   return "UNKNOWN";
 }
@@ -94,12 +121,25 @@ const char* ReqTypeName(ReqType t) {
 // Wire helpers: length-prefixed frames of {u8 tag, payload}.
 // ---------------------------------------------------------------------------
 
-enum class MsgTag : uint8_t { kRequest = 1, kResponse = 2, kShutdown = 3 };
+enum class MsgTag : uint8_t {
+  kRequest = 1,
+  kResponse = 2,
+  kShutdown = 3,
+  kHelloAck = 4,
+};
+
+// Wire protocol version; bumped on incompatible frame-layout changes. Both
+// sides are built from this one source so a mismatch means two ranks loaded
+// different builds — exactly the cross-rank config skew init must reject
+// (the analog of the reference's per-tensor placement validation,
+// mpi_ops.cc:439-449, moved to init time where TPU worlds can check it).
+constexpr int32_t kProtocolVersion = 2;
 
 struct Request {
   int32_t rank = -1;
   ReqType type = ReqType::kAllreduce;
   DType dtype = DType::kF32;
+  RedOp red_op = RedOp::kSum;
   int32_t root_rank = -1;
   std::vector<int64_t> shape;
   std::string name;
@@ -112,7 +152,16 @@ struct Response {
   std::string error;
   std::vector<int64_t> sizes;  // allgather: per-rank first dims
   std::string payload;         // result bytes
-  std::vector<std::string> fused_names;  // co-completed (fusion) group
+  // Fusion (reference: MPIResponse.tensor_names[] >1 entries => fused,
+  // mpi_message.h:94-139; decision mpi_ops.cc:1395-1422): a fused response
+  // carries the concatenated results of several same-dtype allreduces in one
+  // frame; the client splits by per-name byte counts.
+  std::vector<std::string> fused_names;
+  std::vector<int64_t> fused_nbytes;
+  // Coordinator-local bookkeeping (never on the wire).
+  DType dtype = DType::kF32;
+  std::vector<int64_t> shape;                 // output shape (timeline args)
+  std::vector<std::string> per_rank_payloads; // alltoall/reducescatter
 };
 
 class Buf {
@@ -157,6 +206,7 @@ std::string EncodeRequest(const Request& r) {
   b.PutI32(r.rank);
   b.PutU8(static_cast<uint8_t>(r.type));
   b.PutU8(static_cast<uint8_t>(r.dtype));
+  b.PutU8(static_cast<uint8_t>(r.red_op));
   b.PutI32(r.root_rank);
   b.PutU8(static_cast<uint8_t>(r.shape.size()));
   for (int64_t d : r.shape) b.PutI64(d);
@@ -170,6 +220,7 @@ Request DecodeRequest(Reader& rd) {
   r.rank = rd.GetI32();
   r.type = static_cast<ReqType>(rd.GetU8());
   r.dtype = static_cast<DType>(rd.GetU8());
+  r.red_op = static_cast<RedOp>(rd.GetU8());
   r.root_rank = rd.GetI32();
   int nd = rd.GetU8();
   for (int i = 0; i < nd; i++) r.shape.push_back(rd.GetI64());
@@ -186,6 +237,11 @@ std::string EncodeResponse(const Response& r) {
   b.PutStr(r.error);
   b.PutI32(static_cast<int32_t>(r.sizes.size()));
   for (int64_t s : r.sizes) b.PutI64(s);
+  b.PutI32(static_cast<int32_t>(r.fused_names.size()));
+  for (size_t i = 0; i < r.fused_names.size(); i++) {
+    b.PutStr(r.fused_names[i]);
+    b.PutI64(r.fused_nbytes[i]);
+  }
   b.PutStr(r.payload);
   return b.str();
 }
@@ -197,6 +253,11 @@ Response DecodeResponse(Reader& rd) {
   r.error = rd.GetStr();
   int n = rd.GetI32();
   for (int i = 0; i < n; i++) r.sizes.push_back(rd.GetI64());
+  int nf = rd.GetI32();
+  for (int i = 0; i < nf; i++) {
+    r.fused_names.push_back(rd.GetStr());
+    r.fused_nbytes.push_back(rd.GetI64());
+  }
   r.payload = rd.GetStr();
   return r;
 }
@@ -226,9 +287,16 @@ bool RecvAll(int fd, void* p, size_t n) {
   return true;
 }
 
+// Frames above this are protocol violations (a stray/hostile connection
+// sending a garbage 64-bit length must not trigger a std::bad_alloc that
+// terminates the coordinator); 16 GiB comfortably exceeds any real tensor
+// the host eager plane carries.
+constexpr uint64_t kMaxFrameBytes = 1ull << 34;
+
 bool RecvFrame(int fd, std::string* body) {
   uint64_t len;
   if (!RecvAll(fd, &len, 8)) return false;
+  if (len > kMaxFrameBytes) return false;
   body->resize(len);
   return len == 0 || RecvAll(fd, &(*body)[0], len);
 }
@@ -239,15 +307,28 @@ bool RecvFrame(int fd, std::string* body) {
 // ---------------------------------------------------------------------------
 
 template <typename T>
-void SumInto(std::string* acc, const std::string& in) {
+void ReduceInto(RedOp op, std::string* acc, const std::string& in) {
   T* a = reinterpret_cast<T*>(&(*acc)[0]);
   const T* b = reinterpret_cast<const T*>(in.data());
   size_t n = in.size() / sizeof(T);
-  for (size_t i = 0; i < n; i++) a[i] += b[i];
+  switch (op) {
+    case RedOp::kSum:
+      for (size_t i = 0; i < n; i++) a[i] += b[i];
+      return;
+    case RedOp::kMin:
+      for (size_t i = 0; i < n; i++) a[i] = std::min(a[i], b[i]);
+      return;
+    case RedOp::kMax:
+      for (size_t i = 0; i < n; i++) a[i] = std::max(a[i], b[i]);
+      return;
+    case RedOp::kProd:
+      for (size_t i = 0; i < n; i++) a[i] *= b[i];
+      return;
+  }
 }
 
-// bfloat16: widen to float, add, narrow.
-void SumIntoBF16(std::string* acc, const std::string& in) {
+// bfloat16: widen to float, reduce, narrow (round-to-nearest-even).
+void ReduceIntoBF16(RedOp op, std::string* acc, const std::string& in) {
   uint16_t* a = reinterpret_cast<uint16_t*>(&(*acc)[0]);
   const uint16_t* b = reinterpret_cast<const uint16_t*>(in.data());
   size_t n = in.size() / 2;
@@ -257,7 +338,12 @@ void SumIntoBF16(std::string* acc, const std::string& in) {
     float af, bf;
     memcpy(&af, &av, 4);
     memcpy(&bf, &bv, 4);
-    af += bf;
+    switch (op) {
+      case RedOp::kSum: af += bf; break;
+      case RedOp::kMin: af = std::min(af, bf); break;
+      case RedOp::kMax: af = std::max(af, bf); break;
+      case RedOp::kProd: af *= bf; break;
+    }
     uint32_t out;
     memcpy(&out, &af, 4);
     // round-to-nearest-even on the dropped 16 bits
@@ -266,25 +352,27 @@ void SumIntoBF16(std::string* acc, const std::string& in) {
   }
 }
 
-void SumPayload(DType t, std::string* acc, const std::string& in) {
+void ReducePayload(DType t, RedOp op, std::string* acc, const std::string& in) {
   switch (t) {
-    case DType::kU8: return SumInto<uint8_t>(acc, in);
-    case DType::kI8: return SumInto<int8_t>(acc, in);
-    case DType::kU16: return SumInto<uint16_t>(acc, in);
-    case DType::kI16: return SumInto<int16_t>(acc, in);
-    case DType::kI32: return SumInto<int32_t>(acc, in);
-    case DType::kI64: return SumInto<int64_t>(acc, in);
-    case DType::kF32: return SumInto<float>(acc, in);
-    case DType::kF64: return SumInto<double>(acc, in);
+    case DType::kU8: return ReduceInto<uint8_t>(op, acc, in);
+    case DType::kI8: return ReduceInto<int8_t>(op, acc, in);
+    case DType::kU16: return ReduceInto<uint16_t>(op, acc, in);
+    case DType::kI16: return ReduceInto<int16_t>(op, acc, in);
+    case DType::kI32: return ReduceInto<int32_t>(op, acc, in);
+    case DType::kI64: return ReduceInto<int64_t>(op, acc, in);
+    case DType::kF32: return ReduceInto<float>(op, acc, in);
+    case DType::kF64: return ReduceInto<double>(op, acc, in);
     case DType::kBool: {
-      // logical OR for bool sum-parity (reference reduces bool via MPI sum
-      // of bytes; OR keeps it a valid bool)
+      // bool: SUM/MAX = logical OR, MIN/PROD = logical AND (the lattice
+      // forms the reference's MPI byte-sum reduces to for 0/1 values).
       uint8_t* a = reinterpret_cast<uint8_t*>(&(*acc)[0]);
       const uint8_t* b = reinterpret_cast<const uint8_t*>(in.data());
-      for (size_t i = 0; i < in.size(); i++) a[i] = a[i] || b[i];
+      bool is_or = (op == RedOp::kSum || op == RedOp::kMax);
+      for (size_t i = 0; i < in.size(); i++)
+        a[i] = is_or ? (a[i] || b[i]) : (a[i] && b[i]);
       return;
     }
-    case DType::kBF16: return SumIntoBF16(acc, in);
+    case DType::kBF16: return ReduceIntoBF16(op, acc, in);
   }
 }
 
@@ -327,11 +415,22 @@ class Timeline {
     return pid;
   }
 
-  void Event(const std::string& name, const char* ph, const char* ev) {
+  // args_json, when non-empty, is a preformatted JSON object attached to the
+  // event (the reference's End logs output dtype+shape, timeline.cc:203-220).
+  void Event(const std::string& name, const char* ph, const char* ev,
+             const std::string& args_json = "") {
     if (!f_) return;
     std::lock_guard<std::mutex> l(mu_);
-    fprintf(f_, "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld},\n",
-            ev, ph, Pid(name), static_cast<long long>(Now() - start_));
+    if (args_json.empty()) {
+      fprintf(f_, "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld},\n",
+              ev, ph, Pid(name), static_cast<long long>(Now() - start_));
+    } else {
+      fprintf(f_,
+              "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld,"
+              "\"args\":%s},\n",
+              ev, ph, Pid(name), static_cast<long long>(Now() - start_),
+              args_json.c_str());
+    }
     fflush(f_);
   }
 
@@ -359,6 +458,12 @@ class Coordinator {
               const std::string& timeline_path)
       : size_(size), port_(port), fusion_threshold_(fusion_threshold),
         stall_secs_(stall_secs) {
+    // Batch-window width (the reference's 5 ms background-tick period,
+    // mpi_ops.cc:1295); tunable for latency-sensitive eager workloads.
+    if (const char* t = getenv("HOROVOD_COORD_TICK_MS")) {
+      tick_ms_ = atoi(t);
+      if (tick_ms_ < 0) tick_ms_ = 0;
+    }
     if (!timeline_path.empty()) timeline_.Open(timeline_path);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
@@ -388,26 +493,84 @@ class Coordinator {
 
  private:
   void Serve() {
-    // Accept exactly `size` clients; client's first frame is its rank (i32).
+    // Accept exactly `size` clients; client's first frame is its hello
+    // {rank, size, protocol version}. Cross-rank config skew (wrong world
+    // size, mismatched build) and malformed/duplicate hellos are rejected
+    // with a named error WITHOUT killing the accept loop — a stray
+    // connection must not take down the whole world's coordinator
+    // (membership-fault hardening; the reference's MPI world membership is
+    // fixed by mpirun so it never faces this, but it does validate
+    // cross-rank consistency per tensor, mpi_ops.cc:439-449 — here the
+    // world-level part happens once, at init).
     client_fds_.assign(size_, -1);
-    for (int i = 0; i < size_ && !shutdown_.load(); i++) {
+    int accepted = 0;
+    while (accepted < size_ && !shutdown_.load()) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) return;
+      if (fd < 0) return;  // listen socket closed (shutdown path)
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Bound the hello read: a connection that opens and sends nothing (a
+      // port scanner, a load-balancer health probe) must not block the
+      // accept loop and lock real ranks out of the world.
+      timeval hello_timeout{/*tv_sec=*/5, /*tv_usec=*/0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_timeout,
+                 sizeof(hello_timeout));
       std::string hello;
-      if (!RecvFrame(fd, &hello) || hello.size() != 4) { ::close(fd); return; }
-      int32_t rank;
-      memcpy(&rank, hello.data(), 4);
-      if (rank < 0 || rank >= size_ || client_fds_[rank] != -1) {
-        ::close(fd);
-        return;
+      std::string reject;
+      int32_t rank = -1;
+      if (!RecvFrame(fd, &hello) || hello.size() != 12) {
+        reject = "malformed hello frame (client/coordinator build mismatch?)";
+      } else {
+        int32_t csize, cver;
+        memcpy(&rank, hello.data(), 4);
+        memcpy(&csize, hello.data() + 4, 4);
+        memcpy(&cver, hello.data() + 8, 4);
+        std::ostringstream o;
+        if (cver != kProtocolVersion) {
+          o << "protocol version mismatch: coordinator speaks v"
+            << kProtocolVersion << ", rank " << rank << " speaks v" << cver
+            << " (mixed horovod_tpu builds in one world)";
+          reject = o.str();
+        } else if (csize != size_) {
+          o << "world size mismatch: coordinator was launched with size "
+            << size_ << ", but rank " << rank << " was launched with size "
+            << csize << " (check HVD_SIZE / launcher -np on every host)";
+          reject = o.str();
+        } else if (rank < 0 || rank >= size_) {
+          o << "out-of-range rank " << rank << " for world size " << size_;
+          reject = o.str();
+        } else if (client_fds_[rank] != -1) {
+          o << "duplicate rank " << rank
+            << " (two processes claim the same rank; check HVD_RANK)";
+          reject = o.str();
+        }
       }
+      Buf ack;
+      ack.PutU8(static_cast<uint8_t>(MsgTag::kHelloAck));
+      ack.PutU8(reject.empty() ? 1 : 0);
+      ack.PutStr(reject);
+      SendFrame(fd, send_mu_, ack.str());
+      if (!reject.empty()) {
+        fprintf(stderr, "hvdcoord: rejecting client: %s\n", reject.c_str());
+        ::close(fd);
+        continue;
+      }
+      // Admitted: back to blocking reads (the tick loop polls first).
+      timeval no_timeout{0, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
+                 sizeof(no_timeout));
       client_fds_[rank] = fd;
+      accepted++;
     }
 
-    // Tick loop (reference: 5 ms background tick, mpi_ops.cc:1293-1295; here
-    // poll() wakes on arrival, with the tick as stall-check granularity).
+    // Tick loop. The reference's background thread ticks every 5 ms
+    // (mpi_ops.cc:1293-1295): every message that arrived within a tick is
+    // drained BEFORE responses are planned, which is what lets concurrent
+    // announcements (the async API's in-flight batch) fuse. Mirror that
+    // with a batch window: on first arrival, keep ingesting until the
+    // window expires and the sockets are drained, then plan responses.
+    // This bounds per-collective latency at ~tick_ms (the reference's
+    // negotiation latency floor) while letting in-flight batches coalesce.
     std::vector<pollfd> pfds(size_);
     int done_ranks = 0;
     while (!shutdown_.load()) {
@@ -415,25 +578,39 @@ class Coordinator {
         pfds[i] = {client_fds_[i], POLLIN, 0};
       int n = ::poll(pfds.data(), pfds.size(), /*ms=*/5);
       if (n < 0) break;
-      for (int i = 0; i < size_; i++) {
-        if (!(pfds[i].revents & POLLIN)) continue;
-        std::string body;
-        if (!RecvFrame(client_fds_[i], &body)) {
-          // Client gone: coordinated shutdown (mpi_ops.cc:1437-1447).
-          BroadcastShutdown();
-          return;
-        }
-        Reader rd(body);
-        MsgTag tag = static_cast<MsgTag>(rd.GetU8());
-        if (tag == MsgTag::kShutdown) {
-          if (++done_ranks == size_) {
-            BroadcastShutdown();
-            return;
+      if (n > 0) {
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(tick_ms_);
+        while (n > 0 && !shutdown_.load()) {
+          for (int i = 0; i < size_; i++) {
+            if (!(pfds[i].revents & POLLIN)) continue;
+            std::string body;
+            if (!RecvFrame(client_fds_[i], &body)) {
+              // Client gone: coordinated shutdown (mpi_ops.cc:1437-1447).
+              BroadcastShutdown();
+              return;
+            }
+            Reader rd(body);
+            MsgTag tag = static_cast<MsgTag>(rd.GetU8());
+            if (tag == MsgTag::kShutdown) {
+              if (++done_ranks == size_) {
+                BroadcastShutdown();
+                return;
+              }
+              continue;
+            }
+            Request req = DecodeRequest(rd);
+            Ingest(std::move(req));
           }
-          continue;
+          for (int i = 0; i < size_; i++)
+            pfds[i] = {client_fds_[i], POLLIN, 0};
+          auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                          deadline - std::chrono::steady_clock::now())
+                          .count();
+          n = ::poll(pfds.data(), pfds.size(),
+                     left > 0 ? static_cast<int>(left) : 0);
+          if (n < 0) break;
         }
-        Request req = DecodeRequest(rd);
-        Ingest(std::move(req));
       }
       DrainReady();
       CheckStalls();
@@ -447,7 +624,11 @@ class Coordinator {
       p.announced.assign(size_, false);
       p.first_seen = std::chrono::steady_clock::now();
       arrival_order_.push_back(req.name);
-      if (timeline_.enabled()) timeline_.Event(req.name, "B", "NEGOTIATE");
+      if (timeline_.enabled()) {
+        // Phase 1 "NEGOTIATE_<OP>" (timeline.cc:107-140 naming).
+        std::string ev = std::string("NEGOTIATE_") + ReqTypeName(req.type);
+        timeline_.Event(req.name, "B", ev.c_str());
+      }
     }
     if (timeline_.enabled()) {
       std::ostringstream ev;
@@ -463,12 +644,15 @@ class Coordinator {
     // dropped (Python auto-naming makes names unique per call).
   }
 
-  // Process fully-announced tensors in strict arrival order. Tensor fusion
-  // (the reference's 64 MiB same-dtype response batching,
-  // mpi_ops.cc:1395-1422) lives in the COMPILED data plane here
-  // (ops/fusion.py buckets gradients into flat psums); the host eager plane
-  // carries small control-sized tensors where batching buys nothing, so
-  // each ready tensor is executed and answered individually.
+  // Process fully-announced tensors in strict arrival order, fusing
+  // consecutive same-dtype allreduce responses within the threshold into one
+  // frame — the reference's coordinator-side tensor fusion
+  // (mpi_ops.cc:1395-1422: same response type, same dtype, size-capped,
+  // stop at the first non-fusable response so request order is preserved).
+  // The compiled data plane has its own fusion (ops/fusion.py gradient
+  // bucketing); this is the host eager plane's, fed by the async API's
+  // in-flight concurrency (reference: ComputeAsync kernels,
+  // mpi_ops.cc:1752-1772).
   void DrainReady() {
     std::vector<std::string> ready;
     for (auto it = arrival_order_.begin(); it != arrival_order_.end();) {
@@ -480,10 +664,40 @@ class Coordinator {
         ++it;
       }
     }
-    for (auto& name : ready) {
-      Response resp = BuildResponse(name);
-      Emit(resp);
+    std::vector<Response> resps;
+    resps.reserve(ready.size());
+    for (auto& name : ready) resps.push_back(BuildResponse(name));
+
+    size_t i = 0;
+    while (i < resps.size()) {
+      if (!Fusable(resps[i]) || fusion_threshold_ <= 0) {
+        Emit(resps[i]);
+        i++;
+        continue;
+      }
+      // Extend the fusion group while the next response is fusable with the
+      // head (same dtype, cumulative bytes under the threshold).
+      size_t j = i + 1;
+      int64_t total = static_cast<int64_t>(resps[i].payload.size());
+      while (j < resps.size() && Fusable(resps[j]) &&
+             resps[j].dtype == resps[i].dtype &&
+             total + static_cast<int64_t>(resps[j].payload.size()) <=
+                 fusion_threshold_) {
+        total += static_cast<int64_t>(resps[j].payload.size());
+        j++;
+      }
+      if (j - i == 1) {
+        Emit(resps[i]);
+      } else {
+        EmitFused(resps, i, j);
+      }
+      i = j;
     }
+  }
+
+  static bool Fusable(const Response& r) {
+    return r.type == RespType::kAllreduce && r.per_rank_payloads.empty() &&
+           !r.payload.empty();
   }
 
   // ConstructMPIResponse parity (mpi_ops.cc:266-474): cross-rank validation
@@ -497,11 +711,21 @@ class Coordinator {
     resp.name = name;
     std::ostringstream err;
 
+    if (timeline_.enabled()) {
+      // Close phase 1 with the first-arrived request's op (the name the
+      // NEGOTIATE_* begin event used), open the top-level processing event
+      // (timeline.cc:142-166 Start).
+      std::string neg =
+          std::string("NEGOTIATE_") + ReqTypeName(requests.front().type);
+      timeline_.Event(name, "E", neg.c_str());
+    }
+
     // Order requests by rank for deterministic gather concat.
     std::sort(requests.begin(), requests.end(),
               [](const Request& a, const Request& b) { return a.rank < b.rank; });
 
     DType dtype = requests[0].dtype;
+    resp.dtype = dtype;
     for (auto& r : requests) {
       if (r.dtype != dtype) {
         err << "Mismatched data types: One rank had type " << DTypeName(dtype)
@@ -523,7 +747,22 @@ class Coordinator {
       }
     }
 
-    if (op == ReqType::kAllreduce || op == ReqType::kBroadcast) {
+    if (op == ReqType::kAllreduce || op == ReqType::kReducescatter) {
+      RedOp rop = requests[0].red_op;
+      for (auto& r : requests) {
+        if (r.red_op != rop) {
+          err << "Mismatched reduction ops: One rank requested "
+              << RedOpName(rop) << ", but another rank requested "
+              << RedOpName(r.red_op) << ".";
+          resp.type = RespType::kError;
+          resp.error = err.str();
+          return resp;
+        }
+      }
+    }
+
+    if (op == ReqType::kAllreduce || op == ReqType::kBroadcast ||
+        op == ReqType::kAlltoall || op == ReqType::kReducescatter) {
       const auto& shape = requests[0].shape;
       for (auto& r : requests) {
         if (r.shape != shape) {
@@ -575,6 +814,17 @@ class Coordinator {
 
     if (op == ReqType::kBroadcast) {
       int root = requests[0].root_rank;
+      if (root < 0 || root >= size_) {
+        // Out-of-range root is rejected here too (the public Python API
+        // range-checks, but a direct client call must not index out of
+        // bounds; reference root validation: ConstructMPIResponse region
+        // mpi_ops.cc:408-435).
+        err << "Invalid BROADCAST root rank " << root << ": world size is "
+            << size_ << ".";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
       for (auto& r : requests) {
         if (r.root_rank != root) {
           err << "Mismatched BROADCAST root ranks: One rank specified root "
@@ -587,40 +837,152 @@ class Coordinator {
       }
     }
 
-    // Execute the host data plane.
+    if (op == ReqType::kAlltoall || op == ReqType::kReducescatter) {
+      const auto& shape0 = requests[0].shape;
+      if (shape0.empty() || shape0[0] % size_ != 0) {
+        err << ReqTypeName(op) << " requires a first dimension divisible by "
+            << "the world size " << size_ << ", got shape "
+            << ShapeStr(shape0) << ".";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
+    }
+
+    // Execute the host data plane. The top-level processing event wraps a
+    // named activity per op (reference nested activities,
+    // mpi_ops.cc:623-635 / docs/timeline.md:25-43; MPI_ALLREDUCE et al.
+    // become host-plane SUM/CONCAT/BCAST/ALLTOALL/REDUCESCATTER).
+    const char* act = nullptr;
+    switch (op) {
+      case ReqType::kAllreduce: act = "SUM"; break;
+      case ReqType::kAllgather: act = "CONCAT"; break;
+      case ReqType::kBroadcast: act = "BCAST"; break;
+      case ReqType::kAlltoall: act = "ALLTOALL"; break;
+      case ReqType::kReducescatter: act = "REDUCESCATTER"; break;
+    }
+    if (timeline_.enabled()) {
+      timeline_.Event(resp.name, "B", ReqTypeName(op));  // top-level Start
+      timeline_.Event(resp.name, "B", act);
+    }
     switch (op) {
       case ReqType::kAllreduce: {
         resp.type = RespType::kAllreduce;
+        resp.shape = requests[0].shape;
         resp.payload = requests[0].payload;
         for (size_t r = 1; r < requests.size(); r++)
-          SumPayload(dtype, &resp.payload, requests[r].payload);
+          ReducePayload(dtype, requests[0].red_op, &resp.payload,
+                        requests[r].payload);
         break;
       }
       case ReqType::kAllgather: {
         resp.type = RespType::kAllgather;
-        for (auto& r : requests) resp.payload += r.payload;  // rank order
+        resp.shape = requests[0].shape;
+        resp.shape[0] = 0;
+        for (auto& r : requests) {
+          resp.payload += r.payload;  // rank order
+          resp.shape[0] += r.shape[0];
+        }
         break;
       }
       case ReqType::kBroadcast: {
         resp.type = RespType::kBroadcast;
+        resp.shape = requests[0].shape;
         resp.payload = requests[requests[0].root_rank].payload;
         break;
       }
+      case ReqType::kAlltoall: {
+        // Rank r's result = concat over senders s of block r of s's tensor
+        // (lax.all_to_all split_axis=0, concat_axis=0 semantics).
+        resp.type = RespType::kAlltoall;
+        resp.shape = requests[0].shape;
+        size_t block = requests[0].payload.size() / size_;
+        resp.per_rank_payloads.assign(size_, std::string());
+        for (int r = 0; r < size_; r++) {
+          resp.per_rank_payloads[r].reserve(block * size_);
+          for (int s = 0; s < size_; s++)
+            resp.per_rank_payloads[r] +=
+                requests[s].payload.substr(r * block, block);
+        }
+        break;
+      }
+      case ReqType::kReducescatter: {
+        // Sum all tensors, rank r receives block r of the first dimension
+        // (lax.psum_scatter tiled semantics).
+        resp.type = RespType::kReducescatter;
+        resp.shape = requests[0].shape;
+        resp.shape[0] /= size_;
+        std::string sum = requests[0].payload;
+        for (size_t r = 1; r < requests.size(); r++)
+          ReducePayload(dtype, requests[0].red_op, &sum, requests[r].payload);
+        size_t block = sum.size() / size_;
+        resp.per_rank_payloads.assign(size_, std::string());
+        for (int r = 0; r < size_; r++)
+          resp.per_rank_payloads[r] = sum.substr(r * block, block);
+        break;
+      }
     }
+    if (timeline_.enabled()) timeline_.Event(resp.name, "E", act);
     return resp;
   }
 
+  // End-event args: output dtype + shape (timeline.cc:203-220 parity).
+  static std::string TimelineArgs(const Response& r) {
+    std::ostringstream o;
+    o << "{\"dtype\":\"" << DTypeName(r.dtype) << "\",\"shape\":"
+      << ShapeStr(r.shape) << "}";
+    return o.str();
+  }
+
   void Emit(Response& resp) {
-    if (timeline_.enabled()) {
-      timeline_.Event(resp.name, "E", "NEGOTIATE");
-      timeline_.Event(resp.name, "B",
-                      resp.type == RespType::kError ? "ERROR" : "EXECUTE");
+    if (resp.type == RespType::kError) {
+      if (timeline_.enabled()) timeline_.Event(resp.name, "B", "ERROR");
+      std::string body = EncodeResponse(resp);
+      for (int r = 0; r < size_; r++)
+        SendFrame(client_fds_[r], send_mu_, body);
+      if (timeline_.enabled()) timeline_.Event(resp.name, "E", "ERROR");
+      return;
     }
-    std::string body = EncodeResponse(resp);
+    if (timeline_.enabled()) timeline_.Event(resp.name, "B", "RESPOND");
+    if (resp.per_rank_payloads.empty()) {
+      std::string body = EncodeResponse(resp);
+      for (int r = 0; r < size_; r++)
+        SendFrame(client_fds_[r], send_mu_, body);
+    } else {
+      // alltoall/reducescatter: each rank receives its own result slice.
+      for (int r = 0; r < size_; r++) {
+        resp.payload = resp.per_rank_payloads[r];
+        SendFrame(client_fds_[r], send_mu_, EncodeResponse(resp));
+      }
+    }
+    if (timeline_.enabled()) {
+      timeline_.Event(resp.name, "E", "RESPOND");
+      timeline_.Event(resp.name, "E", "", TimelineArgs(resp));  // top-level
+    }
+  }
+
+  // Fused emission: one frame answering resps[lo, hi) at once
+  // (mpi_ops.cc:1395-1422 response batching; tensor_names[] >1 ⇒ fused).
+  void EmitFused(std::vector<Response>& resps, size_t lo, size_t hi) {
+    Response out;
+    out.type = RespType::kAllreduce;
+    out.name = resps[lo].name;
+    for (size_t k = lo; k < hi; k++) {
+      out.fused_names.push_back(resps[k].name);
+      out.fused_nbytes.push_back(
+          static_cast<int64_t>(resps[k].payload.size()));
+      out.payload += resps[k].payload;
+      if (timeline_.enabled())
+        timeline_.Event(resps[k].name, "B", "RESPOND");
+    }
+    std::string body = EncodeResponse(out);
     for (int r = 0; r < size_; r++) SendFrame(client_fds_[r], send_mu_, body);
-    if (timeline_.enabled())
-      timeline_.Event(resp.name, "E",
-                      resp.type == RespType::kError ? "ERROR" : "EXECUTE");
+    if (timeline_.enabled()) {
+      for (size_t k = lo; k < hi; k++) {
+        timeline_.Event(resps[k].name, "E", "RESPOND");
+        timeline_.Event(resps[k].name, "E", "", TimelineArgs(resps[k]));
+      }
+    }
   }
 
   void BroadcastShutdown() {
@@ -680,6 +1042,7 @@ class Coordinator {
   int port_;
   int64_t fusion_threshold_;
   double stall_secs_;
+  int tick_ms_ = 5;
   bool ok_ = true;
   int listen_fd_ = -1;
   std::vector<int> client_fds_;
@@ -720,10 +1083,41 @@ class Client {
     if (!connected_) return;
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::string hello(reinterpret_cast<char*>(&rank_), 4);
+    int32_t ver = kProtocolVersion;
+    std::string hello;
+    hello.append(reinterpret_cast<char*>(&rank_), 4);
+    hello.append(reinterpret_cast<char*>(&size_), 4);
+    hello.append(reinterpret_cast<char*>(&ver), 4);
     SendFrame(fd_, send_mu_, hello);
+    // Synchronous ack: the coordinator validates {rank, size, version}
+    // before admitting us — misconfigured worlds fail HERE with a message,
+    // not minutes later with a hang.
+    std::string ackbody;
+    if (!RecvFrame(fd_, &ackbody) || ackbody.empty() ||
+        static_cast<MsgTag>(ackbody[0]) != MsgTag::kHelloAck) {
+      init_error_ = "coordinator closed the connection during handshake";
+      connected_ = false;
+      return;
+    }
+    Reader rd(ackbody);
+    rd.GetU8();  // tag
+    bool ok = rd.GetU8() != 0;
+    std::string msg = rd.GetStr();
+    if (!ok) {
+      init_error_ = msg;
+      connected_ = false;
+      return;
+    }
     recv_thread_ = std::thread(&Client::RecvLoop, this);
   }
+
+ public:
+  const std::string& init_error() const { return init_error_; }
+
+ private:
+  std::string init_error_;
+
+ public:
 
   ~Client() { Shutdown(); }
 
@@ -776,13 +1170,49 @@ class Client {
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
       std::lock_guard<std::mutex> l(mu_);
-      completed_[resp.name] = std::move(resp);
+      responses_received_++;
+      if (!resp.fused_names.empty()) {
+        // Fused frame: split the concatenated payload back into the
+        // individual ops it answers (reference: one MPIResponse completes
+        // every entry in tensor_names, mpi_ops.cc:1024-1096 memcpy-out).
+        size_t off = 0;
+        for (size_t i = 0; i < resp.fused_names.size(); i++) {
+          Response one;
+          one.type = resp.type;
+          one.name = resp.fused_names[i];
+          size_t n = static_cast<size_t>(resp.fused_nbytes[i]);
+          one.payload = resp.payload.substr(off, n);
+          off += n;
+          ops_completed_++;
+          completed_[one.name] = std::move(one);
+        }
+      } else {
+        ops_completed_++;
+        completed_[resp.name] = std::move(resp);
+      }
       cv_.notify_all();
     }
     std::lock_guard<std::mutex> l(mu_);
     dead_ = true;
     cv_.notify_all();
   }
+
+ public:
+  // Stats for fusion observability (tested by the fused-path analog of
+  // mpi_ops_test.py:116-148): frames received vs ops completed — completed >
+  // received proves response fusion happened.
+  long long responses_received() {
+    std::lock_guard<std::mutex> l(mu_);
+    return responses_received_;
+  }
+  long long ops_completed() {
+    std::lock_guard<std::mutex> l(mu_);
+    return ops_completed_;
+  }
+
+ private:
+  long long responses_received_ = 0;
+  long long ops_completed_ = 0;
 
   int32_t rank_;
   int size_;
@@ -819,10 +1249,12 @@ Global* g() {
 
 extern "C" {
 
-// Returns 0 on success.
+// Returns 0 on success; 1 coordinator bind failure; 2 connect/handshake
+// failure (message in err — e.g. world-size or protocol-version mismatch
+// detected by the coordinator's hello validation).
 int hvdcoord_init(int rank, int size, const char* host, int port,
                   long long fusion_threshold, double stall_secs,
-                  const char* timeline_path) {
+                  const char* timeline_path, char* err, int errlen) {
   using namespace hvdcoord;
   std::lock_guard<std::mutex> l(g()->mu);
   if (g()->client) return 0;  // idempotent (InitializeHorovodOnce parity)
@@ -830,10 +1262,24 @@ int hvdcoord_init(int rank, int size, const char* host, int port,
     g()->coordinator.reset(new Coordinator(
         size, port, fusion_threshold, stall_secs,
         timeline_path ? timeline_path : ""));
-    if (!g()->coordinator->ok()) return 1;
+    if (!g()->coordinator->ok()) {
+      if (err && errlen > 0)
+        snprintf(err, errlen, "coordinator failed to bind/listen on port %d",
+                 port);
+      return 1;
+    }
   }
   g()->client.reset(new Client(rank, size, host, port));
-  if (!g()->client->connected()) return 2;
+  if (!g()->client->connected()) {
+    if (err && errlen > 0) {
+      const std::string& m = g()->client->init_error();
+      snprintf(err, errlen, "%s",
+               m.empty() ? "could not connect to coordinator" : m.c_str());
+    }
+    g()->client.reset();
+    g()->coordinator.reset();
+    return 2;
+  }
   g()->rank = rank;
   g()->size = size;
   return 0;
@@ -842,15 +1288,13 @@ int hvdcoord_init(int rank, int size, const char* host, int port,
 int hvdcoord_rank() { return hvdcoord::g()->client ? hvdcoord::g()->rank : -1; }
 int hvdcoord_size() { return hvdcoord::g()->client ? hvdcoord::g()->size : -1; }
 
-// Submit + wait (eager calls are synchronous). Returns:
-//   0 ok; fills *out (malloc'd; caller frees via hvdcoord_free), *out_nbytes,
-//     and for allgather writes per-rank first dims into sizes_out[size].
-//   1 coordinator-reported validation error (message in err, FailedPrecondition
-//     parity, mpi_ops.cc:1141-1148); 2 transport failure.
-int hvdcoord_run(const char* name, int req_type, int dtype, int root_rank,
-                 int ndim, const long long* shape, const void* data,
-                 long long nbytes, void** out, long long* out_nbytes,
-                 long long* sizes_out, char* err, int errlen) {
+// Non-blocking submit (reference: ComputeAsync + EnqueueTensor*,
+// mpi_ops.cc:1752-1772 — many collectives negotiate concurrently, feeding
+// coordinator-side fusion). Returns 0 ok, 2 transport failure.
+int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
+                    int root_rank, int ndim, const long long* shape,
+                    const void* data, long long nbytes, char* err,
+                    int errlen) {
   using namespace hvdcoord;
   auto* G = g();
   if (!G->client) {
@@ -861,6 +1305,7 @@ int hvdcoord_run(const char* name, int req_type, int dtype, int root_rank,
   req.rank = G->rank;
   req.type = static_cast<ReqType>(req_type);
   req.dtype = static_cast<DType>(dtype);
+  req.red_op = static_cast<RedOp>(red_op);
   req.root_rank = root_rank;
   for (int i = 0; i < ndim; i++) req.shape.push_back(shape[i]);
   req.name = name;
@@ -871,8 +1316,24 @@ int hvdcoord_run(const char* name, int req_type, int dtype, int root_rank,
     snprintf(err, errlen, "hvdcoord: send failed (coordinator down?)");
     return 2;
   }
+  return 0;
+}
+
+// Block until the named op completes. Returns:
+//   0 ok; fills *out (malloc'd; caller frees via hvdcoord_free), *out_nbytes,
+//     and for allgather writes per-rank first dims into sizes_out[size].
+//   1 coordinator-reported validation error (message in err, FailedPrecondition
+//     parity, mpi_ops.cc:1141-1148); 2 transport failure.
+int hvdcoord_wait(const char* name, void** out, long long* out_nbytes,
+                  long long* sizes_out, char* err, int errlen) {
+  using namespace hvdcoord;
+  auto* G = g();
+  if (!G->client) {
+    snprintf(err, errlen, "hvdcoord not initialized");
+    return 2;
+  }
   Response resp;
-  if (!G->client->Wait(req.name, &resp)) {
+  if (!G->client->Wait(name, &resp)) {
     snprintf(err, errlen, "hvdcoord: connection lost while waiting for %s",
              name);
     return 2;
@@ -889,6 +1350,29 @@ int hvdcoord_run(const char* name, int req_type, int dtype, int root_rank,
       sizes_out[i] = resp.sizes[i];
   }
   return 0;
+}
+
+// Submit + wait (synchronous eager calls).
+int hvdcoord_run(const char* name, int req_type, int dtype, int red_op,
+                 int root_rank, int ndim, const long long* shape,
+                 const void* data, long long nbytes, void** out,
+                 long long* out_nbytes, long long* sizes_out, char* err,
+                 int errlen) {
+  int rc = hvdcoord_submit(name, req_type, dtype, red_op, root_rank, ndim,
+                           shape, data, nbytes, err, errlen);
+  if (rc != 0) return rc;
+  return hvdcoord_wait(name, out, out_nbytes, sizes_out, err, errlen);
+}
+
+// Fusion observability: response frames received vs ops completed on this
+// rank's client (completed > received ⇔ some frames were fused).
+long long hvdcoord_responses_received() {
+  using namespace hvdcoord;
+  return g()->client ? g()->client->responses_received() : -1;
+}
+long long hvdcoord_ops_completed() {
+  using namespace hvdcoord;
+  return g()->client ? g()->client->ops_completed() : -1;
 }
 
 void hvdcoord_free(void* p) { free(p); }

@@ -1,0 +1,222 @@
+"""Hook-style training integration — the third integration pattern.
+
+Reference parity: the reference shows three ways to train with Horovod —
+raw ``MonitoredTrainingSession`` loops (``examples/tensorflow_mnist.py``),
+Keras ``model.fit`` + callbacks (``examples/keras_mnist.py``), and
+**Estimator + SessionRunHooks** (``examples/tensorflow_mnist_estimator.py:
+145-191``: ``BroadcastGlobalVariablesHook``, ``StopAtStepHook``,
+``LoggingTensorHook``, rank-0-only ``model_dir``). This module is the
+TPU-native equivalent of the third: a ``SessionRunHook``-shaped protocol, a
+``MonitoredTrainingLoop`` that drives the compiled step through hooks, and a
+compact ``Estimator`` façade.
+
+The framework's other two patterns live in :class:`horovod_tpu.Trainer`
+(fit + callbacks) and plain loops over ``make_train_step``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from . import runtime
+from .training import TrainState, shard_batch
+
+
+class TrainingHook:
+    """SessionRunHook protocol (reference ``tf.train.SessionRunHook``
+    lifecycle used by ``BroadcastGlobalVariablesHook``,
+    ``horovod/tensorflow/__init__.py:93-124``)."""
+
+    def begin(self, loop: "MonitoredTrainingLoop"): ...
+
+    def after_create_session(self, loop: "MonitoredTrainingLoop"): ...
+
+    def before_run(self, loop: "MonitoredTrainingLoop", step: int): ...
+
+    def after_run(self, loop: "MonitoredTrainingLoop", step: int,
+                  metrics: Dict[str, Any]): ...
+
+    def end(self, loop: "MonitoredTrainingLoop"): ...
+
+
+class BroadcastGlobalVariablesHook(TrainingHook):
+    """Broadcast initial state from ``root_rank`` once the loop starts
+    (parity: ``hvd.BroadcastGlobalVariablesHook``, built in ``begin()``,
+    run in ``after_create_session`` — ``__init__.py:93-124``)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def after_create_session(self, loop):
+        from .optimizer import broadcast_global_variables
+        if runtime.is_initialized() and runtime.size() > 1:
+            loop.state = broadcast_global_variables(
+                loop.state, root_rank=self.root_rank)
+
+
+class StopAtStepHook(TrainingHook):
+    """Stop after ``last_step`` global steps (reference
+    ``tf.train.StopAtStepHook``, ``tensorflow_mnist_estimator.py:169``)."""
+
+    def __init__(self, last_step: int):
+        self.last_step = last_step
+
+    def after_run(self, loop, step, metrics):
+        if step + 1 >= self.last_step:
+            loop.request_stop()
+
+
+class LoggingHook(TrainingHook):
+    """Print metrics every ``every_n_steps``, rank 0 only (reference
+    ``tf.train.LoggingTensorHook``, ``tensorflow_mnist_estimator.py:170-173``;
+    rank-0 verbosity convention ``keras_imagenet_resnet50.py:59``)."""
+
+    def __init__(self, every_n_steps: int = 10):
+        self.every_n_steps = every_n_steps
+        self._t0 = None
+
+    def begin(self, loop):
+        self._t0 = time.perf_counter()
+
+    def after_run(self, loop, step, metrics):
+        if (step + 1) % self.every_n_steps:
+            return
+        if runtime.is_initialized() and runtime.world().controller_rank != 0:
+            return
+        dt = time.perf_counter() - self._t0
+        msg = " ".join(f"{k}={float(np.asarray(v)):.4f}"
+                       for k, v in metrics.items())
+        print(f"step {step + 1} [{dt:.1f}s] {msg}", flush=True)
+
+
+class CheckpointSaverHook(TrainingHook):
+    """Rank-0-only periodic checkpointing (the reference's Estimator writes
+    checkpoints only where ``model_dir`` is set, which is rank 0 —
+    ``tensorflow_mnist_estimator.py:145-147``, ``README.md:78-80``)."""
+
+    def __init__(self, checkpoint_dir: str, save_steps: int = 100):
+        self.checkpoint_dir = checkpoint_dir
+        self.save_steps = save_steps
+
+    def after_run(self, loop, step, metrics):
+        if (step + 1) % self.save_steps == 0:
+            from .trainer import save_checkpoint
+            save_checkpoint(self.checkpoint_dir, loop.state)
+
+    def end(self, loop):
+        from .trainer import save_checkpoint
+        save_checkpoint(self.checkpoint_dir, loop.state)
+
+
+class MonitoredTrainingLoop:
+    """Drive a compiled train step through hooks (the
+    ``MonitoredTrainingSession`` analog: hooks observe/steer the loop, the
+    loop owns the state)."""
+
+    def __init__(self, train_step: Callable, state: TrainState,
+                 hooks: Sequence[TrainingHook] = ()):
+        self.train_step = train_step
+        self.state = state
+        self.hooks: List[TrainingHook] = list(hooks)
+        self._stop = False
+        self.global_step = 0
+
+    def request_stop(self):
+        self._stop = True
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def run(self, data: Iterable) -> TrainState:
+        """Run until the data iterable ends or a hook requests stop; the
+        iterable yields global host batches (sharded here)."""
+        for h in self.hooks:
+            h.begin(self)
+        for h in self.hooks:
+            h.after_create_session(self)
+        for batch in data:
+            if self._stop:
+                break
+            step = self.global_step
+            for h in self.hooks:
+                h.before_run(self, step)
+            self.state, metrics = self.train_step(self.state,
+                                                  shard_batch(batch))
+            for h in self.hooks:
+                h.after_run(self, step, metrics)
+            self.global_step += 1
+        for h in self.hooks:
+            h.end(self)
+        return self.state
+
+
+class Estimator:
+    """Compact Estimator façade over the hook loop (reference usage shape:
+    ``tf.estimator.Estimator(model_fn, model_dir).train(input_fn, steps,
+    hooks)``, ``tensorflow_mnist_estimator.py:145-191``).
+
+    ``model_dir`` should be set on rank 0 only (pass ``None`` elsewhere), as
+    in the reference; a :class:`BroadcastGlobalVariablesHook` keeps the other
+    ranks consistent.
+    """
+
+    def __init__(self, model, optimizer, *,
+                 model_dir: Optional[str] = None,
+                 sample_input, rng=None,
+                 loss_fn: Optional[Callable] = None,
+                 metrics_fn: Optional[Callable] = None):
+        import jax
+        from . import training
+        self.model = model
+        self.model_dir = model_dir
+        self._training = training
+        kwargs = {}
+        if loss_fn is not None:
+            kwargs["loss_fn"] = loss_fn
+        self.state, self._dist_opt = training.create_train_state(
+            model, rng if rng is not None else jax.random.PRNGKey(0),
+            sample_input, optimizer)
+        self._train_step = training.make_train_step(
+            model, self._dist_opt, metrics_fn=metrics_fn, **kwargs)
+        self._eval_step = training.make_eval_step(model, **kwargs)
+
+    def train(self, input_fn: Callable[[], Iterable],
+              steps: Optional[int] = None,
+              hooks: Sequence[TrainingHook] = ()) -> "Estimator":
+        hooks = list(hooks)
+        if steps is not None:
+            hooks.append(StopAtStepHook(steps))
+        if self.model_dir is not None:
+            hooks.append(CheckpointSaverHook(self.model_dir))
+        loop = MonitoredTrainingLoop(self._train_step, self.state, hooks)
+
+        def _stream():
+            while True:
+                yielded = False
+                for b in input_fn():
+                    yielded = True
+                    yield b
+                if steps is None or not yielded:
+                    return  # single pass when steps unbounded / empty data
+
+        self.state = loop.run(_stream())
+        return self
+
+    def evaluate(self, input_fn: Callable[[], Iterable]) -> Dict[str, float]:
+        """Weighted-mean eval over ``input_fn()`` batches, globally averaged
+        in-step (the reference's allreduced final eval,
+        ``keras_imagenet_resnet50.py:150``)."""
+        import jax
+        totals: Dict[str, float] = {}
+        rows_total = 0
+        for batch in input_fn():
+            rows = int(np.shape(jax.tree_util.tree_leaves(batch)[0])[0])
+            metrics = self._eval_step(self.state, shard_batch(batch))
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + rows * float(np.asarray(v))
+            rows_total += rows
+        return {k: v / max(rows_total, 1) for k, v in totals.items()}

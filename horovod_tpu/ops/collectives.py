@@ -132,6 +132,7 @@ def _eager_fn(mesh_key, kind: str, per_rank: bool, squeeze: bool, op: Op,
     mesh = runtime.mesh()
     in_spec = P(AXIS) if per_rank else P()
 
+    out_spec = P()
     if kind == "allreduce":
         def f(x):
             return _reduce_in_trace(x, op)
@@ -141,6 +142,22 @@ def _eager_fn(mesh_key, kind: str, per_rank: bool, squeeze: bool, op: Op,
     elif kind == "broadcast":
         def f(x):
             return _broadcast_in_trace(x, root_rank)
+    elif kind == "alltoall":
+        # Per-rank results differ; the output stays sharded over the world
+        # axis (each rank's block is its own exchange result).
+        out_spec = P(AXIS)
+
+        def f(x):
+            return lax.all_to_all(x, AXIS, 0, 0, tiled=True)
+    elif kind == "reducescatter":
+        out_spec = P(AXIS)
+        if op not in (Op.SUM, Op.AVERAGE):
+            raise ValueError(
+                f"compiled reducescatter supports SUM/AVERAGE; got {op}")
+
+        def f(x):
+            out = lax.psum_scatter(x, AXIS, tiled=True)
+            return out / runtime.size() if op is Op.AVERAGE else out
     else:
         raise ValueError(kind)
 
@@ -151,7 +168,7 @@ def _eager_fn(mesh_key, kind: str, per_rank: bool, squeeze: bool, op: Op,
         f = lambda x: inner(x[0])  # noqa: E731
 
     return jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=P()))
+        jax.shard_map(f, mesh=mesh, in_specs=in_spec, out_specs=out_spec))
 
 
 def _is_per_rank(x) -> bool:
@@ -176,14 +193,40 @@ def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
         # request across processes before dispatch (host DCN plane).
         return w.coord.collective(kind, x, name, op=op, root_rank=root_rank)
 
+    if kind in ("alltoall", "reducescatter"):
+        if not per_rank:
+            raise ValueError(
+                f"eager single-controller {kind} needs input sharded over "
+                f"the world axis on dim 0 (each rank's block is its tensor); "
+                f"got a replicated/host value — use shard_batch or a "
+                f"NamedSharding(P('{AXIS}'))")
+        # Global dim 0 = size × per-rank block; each block must again split
+        # `size` ways inside the exchange, so the global dim needs size².
+        if x.ndim < 1 or x.shape[0] % (w.size * w.size):
+            raise ValueError(
+                f"single-controller eager {kind} needs a global first "
+                f"dimension divisible by size²={w.size * w.size} (per-rank "
+                f"blocks of size a multiple of {w.size}); got shape "
+                f"{tuple(x.shape)}")
+        squeeze = False
+    else:
+        squeeze = per_rank and x.ndim >= 1 and x.shape[0] == w.size
+
     tl = w.timeline
     if tl is not None:
+        # Single-controller: negotiation is synthesized (SPMD needs none);
+        # the processing phase wraps the real dispatch activities
+        # (docs/timeline.md nested-activity model, mpi_ops.cc:623-635).
         tl.negotiate_instant(name, kind.upper(), ready_ranks=range(w.size))
         tl.start(name, kind.upper())
-    squeeze = per_rank and x.ndim >= 1 and x.shape[0] == w.size
+        tl.activity_start(name, "SCHEDULE")
     fn = _eager_fn(runtime._generation, kind, per_rank, squeeze, op, root_rank)
+    if tl is not None:
+        tl.activity_end(name)
+        tl.activity_start(name, "XLA_EXECUTE")
     out = fn(x)
     if tl is not None:
+        tl.activity_end(name)
         tl.end(name, out)
     return out
 
@@ -289,22 +332,108 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
 def alltoall(tensor, split_axis: int = 0, concat_axis: int = 0,
              name: Optional[str] = None, axis_name: str = AXIS):
     """All-to-all exchange (TPU-era extra; not in reference v0.11.2 —
-    needed by all-to-all sequence/context parallelism, SURVEY §5.7)."""
-    del name
+    needed by all-to-all sequence/context parallelism, SURVEY §5.7).
+
+    In-trace: ``lax.all_to_all`` over ICI. Eagerly: dim 0 is split into
+    ``size`` blocks and rank ``r`` receives block ``r`` from every rank,
+    concatenated — via the host coordination plane (multi-process) or a
+    compiled exchange on the mesh (single-controller; the input must be
+    sharded over the world axis, each rank's block being its tensor).
+    """
     if _in_trace():
         return lax.all_to_all(tensor, axis_name, split_axis, concat_axis,
                               tiled=True)
-    raise NotImplementedError("alltoall is compiled-only; call under "
-                              "shard_map over the world mesh")
+    if split_axis != 0 or concat_axis != 0:
+        raise NotImplementedError(
+            "eager alltoall supports split_axis=0/concat_axis=0; transpose "
+            "first or call in-trace under shard_map")
+    return _eager_dispatch("alltoall", tensor, _auto_name("Alltoall", name))
 
 
-def reducescatter(tensor, name: Optional[str] = None, axis_name: str = AXIS):
-    """Reduce-scatter (TPU-era extra): psum then shard dim 0 across ranks."""
-    del name
+def reducescatter(tensor, average: bool = False,
+                  name: Optional[str] = None, op: Optional[Op] = None,
+                  axis_name: str = AXIS):
+    """Reduce-scatter (TPU-era extra): reduce across ranks, then rank ``r``
+    keeps block ``r`` of the first dimension.
+
+    In-trace: ``lax.psum_scatter`` over ICI (SUM/AVERAGE). Eagerly:
+    host coordination plane (multi-process; any reduction op) or compiled
+    exchange (single-controller, input sharded over the world axis).
+    """
+    resolved = op if op is not None else (Op.AVERAGE if average else Op.SUM)
     if _in_trace():
-        return lax.psum_scatter(tensor, axis_name, tiled=True)
-    raise NotImplementedError("reducescatter is compiled-only; call under "
-                              "shard_map over the world mesh")
+        if resolved not in (Op.SUM, Op.AVERAGE):
+            raise ValueError(
+                f"in-trace reducescatter supports SUM/AVERAGE (XLA "
+                f"reduce-scatter is a sum); got {resolved}")
+        out = lax.psum_scatter(tensor, axis_name, tiled=True)
+        if resolved is Op.AVERAGE:
+            out = out / runtime.size()
+        return out
+    return _eager_dispatch("reducescatter", tensor,
+                           _auto_name("Reducescatter", name), op=resolved)
+
+
+# ---------------------------------------------------------------------------
+# Async eager API (reference model: ComputeAsync kernels + done callbacks,
+# mpi_ops.cc:1752-1772 — dozens of collectives negotiate concurrently from
+# TF's executor threads, feeding coordinator-side fusion). Handles are
+# redeemed out-of-order-safe with synchronize().
+# ---------------------------------------------------------------------------
+
+class _DoneHandle:
+    """Pre-completed handle (single-controller eager dispatch is already a
+    single compiled call; there is nothing to overlap)."""
+
+    def __init__(self, result):
+        self._result = result
+
+
+def _submit_async(kind: str, x, name: Optional[str], *, op: Op = Op.SUM,
+                  root_rank: int = 0):
+    if _in_trace():
+        raise RuntimeError(
+            f"{kind}_async_ is an eager API; inside compiled code use the "
+            f"synchronous form — XLA already overlaps collectives")
+    w = runtime.world()
+    full_name = _auto_name(kind.capitalize(), name)
+    if w.coord is not None:
+        return w.coord.submit(kind, jnp.asarray(x), full_name, op=op,
+                              root_rank=root_rank)
+    return _DoneHandle(_eager_dispatch(kind, jnp.asarray(x), full_name,
+                                       op=op, root_rank=root_rank))
+
+
+def allreduce_async_(tensor, average: bool = True,
+                     name: Optional[str] = None, op: Optional[Op] = None):
+    """Non-blocking :func:`allreduce`; returns a handle for
+    :func:`synchronize`. Overlapped submissions negotiate concurrently and
+    are fused by the coordinator (64 MiB same-dtype batching)."""
+    resolved = op if op is not None else (Op.AVERAGE if average else Op.SUM)
+    return _submit_async("allreduce", tensor, name, op=resolved)
+
+
+def allgather_async_(tensor, name: Optional[str] = None):
+    """Non-blocking :func:`allgather`; returns a handle."""
+    return _submit_async("allgather", tensor, name)
+
+
+def broadcast_async_(tensor, root_rank: int = 0,
+                     name: Optional[str] = None):
+    """Non-blocking :func:`broadcast`; returns a handle."""
+    if runtime.is_initialized() and not 0 <= root_rank < runtime.size():
+        raise ValueError(
+            f"root_rank {root_rank} is out of range for world size "
+            f"{runtime.size()}")
+    return _submit_async("broadcast", tensor, name, root_rank=root_rank)
+
+
+def synchronize(handle):
+    """Block until an async handle's collective completes; returns the
+    result. Handles may be synchronized in any order."""
+    if isinstance(handle, _DoneHandle):
+        return handle._result
+    return handle.client.wait(handle)
 
 
 def grouped_allreduce(tensors, average: bool = True,

@@ -66,7 +66,7 @@ class Trainer:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             nsteps = 0
-            last_metrics: Dict[str, Any] = {}
+            epoch_metrics: List[Dict[str, Any]] = []
             for batch_idx, batch in enumerate(data()):
                 if self.steps_per_epoch is not None \
                         and batch_idx >= self.steps_per_epoch:
@@ -75,21 +75,34 @@ class Trainer:
                     cb.on_batch_begin(batch_idx)
                 self.state, metrics = self.train_step(
                     self.state, shard_batch(batch))
-                last_metrics = metrics
+                epoch_metrics.append(metrics)
                 for cb in callbacks:
                     cb.on_batch_end(batch_idx)
                 nsteps += 1
             if self.steps_per_epoch is None:
                 self.steps_per_epoch = nsteps
 
-            logs = {k: float(np.asarray(v)) for k, v in last_metrics.items()}
+            # Epoch logs are the running mean over the epoch's batches (the
+            # Keras fit semantics the reference callbacks assume), not the
+            # last batch — ReduceLROnPlateau/MetricAverage need a stable
+            # signal, not one noisy step.
+            logs: Dict[str, float] = {}
+            if epoch_metrics:
+                for k in epoch_metrics[0]:
+                    logs[k] = float(np.mean(
+                        [np.asarray(m[k]) for m in epoch_metrics]))
             if eval_data is not None and self.eval_step is not None:
-                evals = [self.eval_step(self.state, shard_batch(b))
-                         for b in eval_data()]
+                evals = []
+                for b in eval_data():
+                    rows = int(np.shape(
+                        jax.tree_util.tree_leaves(b)[0])[0])
+                    evals.append((rows, self.eval_step(self.state,
+                                                       shard_batch(b))))
                 if evals:  # the eval iterable can be empty at large world sizes
-                    for k in evals[0]:
-                        logs[f"val_{k}"] = float(np.mean(
-                            [np.asarray(e[k]) for e in evals]))
+                    total = sum(r for r, _ in evals)
+                    for k in evals[0][1]:
+                        logs[f"val_{k}"] = float(sum(
+                            r * np.asarray(e[k]) for r, e in evals) / total)
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs)
             self.history.append(logs)

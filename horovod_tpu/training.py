@@ -227,9 +227,15 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
             batch_stats=new_stats if new_stats is not None
             else state.batch_stats)
 
-    grads_jit = jax.jit(_grads)
-    # dist_opt's in-trace psum needs the axis bound; over the 1-device local
-    # mesh it is the identity (grads were already averaged on the host).
+    # Both halves run under shard_map over the 1-device local mesh so the
+    # world axis is bound: models built with axis_name (cross-replica
+    # BatchNorm) trace lax.pmean(AXIS) inside _grads, and dist_opt's
+    # in-trace psum appears in _apply. Over one local device both are the
+    # identity — the real cross-rank averaging is the host-plane fused
+    # allreduce between the two calls.
+    grads_jit = jax.jit(jax.shard_map(
+        _grads, mesh=mesh, in_specs=(P(), P(AXIS), P(AXIS)),
+        out_specs=P(), check_vma=False))
     apply_jit = jax.jit(jax.shard_map(
         _apply, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
         check_vma=False))
@@ -241,22 +247,42 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         loss, logits, new_stats, grads = grads_jit(state, inputs, labels)
 
         # Host-plane fused gradient averaging (the MPI_Allreduce analog).
+        # Every bucket and metric is SUBMITTED before anything is waited on:
+        # overlapped announcements negotiate concurrently and the
+        # coordinator answers them in fused response frames — the
+        # ComputeAsync concurrency model that feeds fusion in the reference
+        # (mpi_ops.cc:1752-1772, 1395-1422). One synchronous round trip per
+        # step instead of one per bucket.
         from .ops.collectives import Op
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         counter["n"] += 1
         tag = counter["n"]
+        buckets = plan_buckets(leaves)
+        handles = []
+        for bi, bucket in enumerate(buckets):
+            if len(bucket) == 1:
+                payload = np.asarray(leaves[bucket[0]])
+            else:
+                payload = np.concatenate(
+                    [np.ravel(np.asarray(leaves[j])) for j in bucket])
+            handles.append(w.coord.submit(
+                "allreduce", payload, f"grad.{tag}.{bi}", op=Op.AVERAGE))
+        metric_handles = {"loss": w.coord.submit(
+            "allreduce", np.asarray(loss, np.float32),
+            f"metric.loss.{tag}", op=Op.AVERAGE)}
+        if metrics_fn is not None:
+            for k, v in metrics_fn(logits, labels).items():
+                metric_handles[k] = w.coord.submit(
+                    "allreduce", np.asarray(v, np.float32),
+                    f"metric.{k}.{tag}", op=Op.AVERAGE)
+
         reduced = [None] * len(leaves)
-        for bi, bucket in enumerate(plan_buckets(leaves)):
+        for bi, bucket in enumerate(buckets):
+            out = np.asarray(w.coord.wait(handles[bi]))
             if len(bucket) == 1:
                 j = bucket[0]
-                reduced[j] = w.coord.collective(
-                    "allreduce", np.asarray(leaves[j]),
-                    f"grad.{tag}.{bi}", op=Op.AVERAGE)
+                reduced[j] = out.reshape(leaves[j].shape)
             else:
-                flat = np.concatenate(
-                    [np.ravel(np.asarray(leaves[j])) for j in bucket])
-                out = np.asarray(w.coord.collective(
-                    "allreduce", flat, f"grad.{tag}.{bi}", op=Op.AVERAGE))
                 off = 0
                 for j in bucket:
                     n = leaves[j].size
@@ -265,14 +291,7 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
 
         state = apply_jit(state, grads, new_stats)
-        metrics = {"loss": w.coord.collective(
-            "allreduce", np.asarray(loss, np.float32),
-            f"metric.loss.{tag}", op=Op.AVERAGE)}
-        if metrics_fn is not None:
-            for k, v in metrics_fn(logits, labels).items():
-                metrics[k] = w.coord.collective(
-                    "allreduce", np.asarray(v, np.float32),
-                    f"metric.{k}.{tag}", op=Op.AVERAGE)
+        metrics = {k: w.coord.wait(h) for k, h in metric_handles.items()}
         return state, metrics
 
     return step
@@ -305,6 +324,29 @@ def make_eval_step(model, *, mesh: Optional[jax.sharding.Mesh] = None,
         )(state, inputs, labels)
 
     jitted = jax.jit(_sharded)
+
+    if _is_env_world(mesh):
+        # Independent processes: the in-step pmean is the identity over the
+        # 1-device local mesh, so the cross-rank average must ride the host
+        # plane — same split as the env-world train step. All metrics are
+        # submitted before any is waited (they fuse).
+        import numpy as np
+        from .ops.collectives import Op
+        w = runtime.world()
+        counter = {"n": 0}
+
+        def step(state: TrainState, batch):
+            inputs, labels = batch
+            local = jitted(state, inputs, labels)
+            counter["n"] += 1
+            tag = counter["n"]
+            handles = {k: w.coord.submit(
+                "allreduce", np.asarray(v, np.float32),
+                f"evalmetric.{k}.{tag}", op=Op.AVERAGE)
+                for k, v in local.items()}
+            return {k: w.coord.wait(h) for k, h in handles.items()}
+
+        return step
 
     def step(state: TrainState, batch):
         inputs, labels = batch

@@ -68,6 +68,65 @@ def main():
                                              root_rank=root))
         assert np.allclose(out_b, np.arange(5) * 7), out_b
 
+        # Reduction ops beyond SUM (compiled-plane Op parity).
+        from horovod_tpu.ops.collectives import Op
+        xm = np.asarray([float(rank), 10.0 - rank, 3.0], np.float32)
+        outmin = np.asarray(client.collective("allreduce", xm, "t.min",
+                                              op=Op.MIN))
+        assert np.allclose(outmin, [0.0, 10.0 - (size - 1), 3.0]), outmin
+        outmax = np.asarray(client.collective("allreduce", xm, "t.max",
+                                              op=Op.MAX))
+        assert np.allclose(outmax, [float(size - 1), 10.0, 3.0]), outmax
+        outprod = np.asarray(client.collective(
+            "allreduce", np.full((2,), 2.0, np.float32), "t.prod",
+            op=Op.PRODUCT))
+        assert np.allclose(outprod, 2.0 ** size), outprod
+
+        # Integer AVERAGE promotes to float (same semantics as the compiled
+        # plane's lax.pmean — no silent floor division).
+        xa = np.full((3,), 1, np.int32)
+        outa = np.asarray(client.collective("allreduce", xa, "t.intavg",
+                                            op=Op.AVERAGE))
+        assert np.issubdtype(outa.dtype, np.floating), outa.dtype
+        assert np.allclose(outa, 1.0), outa
+
+        # Async submit/wait: N small same-dtype allreduces in flight at once
+        # complete out-of-order-safe AND arrive fused (coordinator-side
+        # response fusion; the analog of mpi_ops_test.py:116-148's
+        # deliberately-fused variants).
+        resp_before = client.responses_received()
+        handles = [client.submit(
+            "allreduce", np.full((8,), float(i + 1), np.float32),
+            f"t.fused.{i}") for i in range(6)]
+        for i, h in enumerate(reversed(handles)):  # reverse: out-of-order
+            j = len(handles) - 1 - i
+            out = np.asarray(client.wait(h))
+            assert np.allclose(out, (j + 1) * size), (j, out)
+        resp_delta = client.responses_received() - resp_before
+        ops_delta = 6
+        if size > 1:
+            # At least some of the 6 ops must have shared a response frame.
+            # (All 6 are announced before any wait, so the coordinator sees
+            # them ready together and fuses within the 64 MiB threshold.)
+            assert resp_delta < ops_delta, (resp_delta, ops_delta)
+
+        # Eager alltoall: rank r sends block s to rank s; receives block r
+        # of every rank (lax.all_to_all semantics).
+        a2a = np.arange(size * 2, dtype=np.float32) + 100.0 * rank
+        out_a2a = np.asarray(client.collective("alltoall", a2a, "t.a2a"))
+        expect = np.concatenate(
+            [np.arange(rank * 2, rank * 2 + 2) + 100.0 * s
+             for s in range(size)]).astype(np.float32)
+        assert np.allclose(out_a2a, expect), (out_a2a, expect)
+
+        # Eager reducescatter: sum across ranks, keep own block.
+        rs = np.arange(size * 3, dtype=np.float32) * (rank + 1)
+        out_rs = np.asarray(client.collective("reducescatter", rs, "t.rs"))
+        total = sum(r + 1 for r in range(size))
+        expect_rs = (np.arange(size * 3, dtype=np.float32)
+                     * total)[rank * 3:(rank + 1) * 3]
+        assert np.allclose(out_rs, expect_rs), (out_rs, expect_rs)
+
         # Negative tests need >1 rank to produce a mismatch; self-skip at
         # size 1 like the reference's (mpi_ops_test.py:291-293).
         if size > 1:

@@ -59,6 +59,24 @@ def main():
         losses.append(float(np.asarray(metrics["loss"])))
     assert losses[-1] < losses[0], losses
 
+    # Cross-replica BatchNorm model under env-world: lax.pmean(axis_name)
+    # inside the model must resolve in the jitted grads step (the axis is
+    # bound by shard_map over the local mesh; the cross-rank part rides the
+    # host plane).
+    bn_model = models.cifar_resnet_v1(8, dtype=jnp.float32,
+                                      axis_name=hvd.AXIS)
+    bn_state, bn_opt = training.create_train_state(
+        bn_model, jax.random.PRNGKey(1),
+        jnp.zeros((2, 16, 16, 3), jnp.float32), optax.sgd(0.05))
+    bn_step = training.make_train_step(bn_model, bn_opt)
+    xb = rng.randn(2 * s, 16, 16, 3).astype(np.float32)
+    yb = rng.randint(0, 10, size=(2 * s,))
+    bn_batch = (jnp.asarray(xb), jnp.asarray(yb))
+    for _ in range(2):
+        bn_state, bn_metrics = bn_step(bn_state,
+                                       training.shard_batch(bn_batch))
+    assert np.isfinite(float(np.asarray(bn_metrics["loss"])))
+
     # Replicas must hold identical params after host-plane averaging.
     checksum = np.asarray(
         sum(float(jnp.sum(jnp.abs(l)))

@@ -91,12 +91,31 @@ class TestTimeline:
             assert p.returncode == 0, out
         events = json.load(open(tl))
         names = {e.get("name") for e in events}
-        assert "NEGOTIATE" in names, names
-        assert "EXECUTE" in names, names
+        # Phase 1 negotiation + phase 2 top-level with nested activities
+        # (timeline.cc:107-220 model: NEGOTIATE_<OP> → <OP> → SUM → RESPOND).
+        assert "NEGOTIATE_ALLREDUCE" in names, names
+        assert "ALLREDUCE" in names, names
+        assert "SUM" in names, names
+        assert "RESPOND" in names, names
         # Per-tensor "process" metadata rows (timeline.cc model).
         assert any(e.get("ph") == "M" for e in events)
         assert any("rank_0_ready" == e.get("name") for e in events)
         assert any("rank_1_ready" == e.get("name") for e in events)
+        # Balanced B/E pairs per pid (the state machine assertion) and
+        # dtype+shape args on the closing top-level End
+        # (timeline.cc:203-220 parity).
+        depth = {}
+        for e in events:
+            if e.get("ph") == "B":
+                depth[e["pid"]] = depth.get(e["pid"], 0) + 1
+            elif e.get("ph") == "E":
+                depth[e["pid"]] = depth.get(e["pid"], 0) - 1
+                assert depth[e["pid"]] >= 0, events
+        assert all(d == 0 for d in depth.values()), depth
+        end_args = [e.get("args", {}) for e in events
+                    if e.get("ph") == "E" and e.get("args")]
+        assert any(a.get("dtype") == "float32" and a.get("shape") == [4]
+                   for a in end_args), end_args
 
     def test_single_controller_timeline(self, tmp_path):
         """HOROVOD_TIMELINE single-controller: the Python writer records
@@ -122,3 +141,11 @@ class TestTimeline:
         events = json.load(open(tl))
         assert any("HorovodAllreduce_tl_single" in str(e.get("args", {}))
                    or "tl_single" in str(e) for e in events), events[:5]
+        # Nested activities inside the top-level processing event (the
+        # Python writer's activity_start/end call sites) and output
+        # dtype+shape on End.
+        names = {e.get("name") for e in events}
+        assert "SCHEDULE" in names, names
+        assert "XLA_EXECUTE" in names, names
+        assert any(e.get("ph") == "E" and "shape" in e.get("args", {})
+                   and "dtype" in e.get("args", {}) for e in events), events

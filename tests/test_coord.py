@@ -45,9 +45,161 @@ def _spawn_world(size: int, timeout: int = 120):
     return outs
 
 
-@pytest.mark.parametrize("size", [1, 2, 4])
+@pytest.mark.parametrize("size", [1, 2, 4, 8])
 def test_coord_world(size):
     outs = _spawn_world(size)
     for rank, (rc, out) in enumerate(outs):
         assert rc == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank}: OK" in out
+
+
+def test_rank_death_mid_collective_propagates_transport_error():
+    """Kill one rank mid-collective: every survivor must get a clean
+    TransportError (not a hang) via the coordinated-shutdown-on-client-death
+    path (reference: errors surface on every pending op, mpi_ops.cc:535-572;
+    here coordinator Serve() broadcasts SHUTDOWN on client EOF)."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import TransportError
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 3, "127.0.0.1", {port})
+        if rank == 2:
+            # Announce once so the world is up, then die without
+            # participating in the second collective.
+            c.collective("allreduce", np.ones(2, np.float32), "warmup")
+            os._exit(17)
+        c.collective("allreduce", np.ones(2, np.float32), "warmup")
+        try:
+            c.collective("allreduce", np.ones(2, np.float32), "doomed")
+            print(f"rank {{rank}}: NO ERROR", flush=True)
+        except TransportError:
+            print(f"rank {{rank}}: TRANSPORT_ERROR", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(3):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert procs[2].returncode == 17
+    for rank in (0, 1):
+        assert "TRANSPORT_ERROR" in outs[rank], (rank, outs[rank])
+
+
+def test_stray_client_does_not_kill_coordinator():
+    """A junk/duplicate/out-of-range hello must be rejected without killing
+    the accept loop: the real world still forms and completes collectives."""
+    import socket as socket_mod
+    import struct
+    import textwrap
+    import threading
+    port = _free_port()
+
+    def _harass():
+        # Out-of-range rank, duplicate rank, wrong world size, wrong
+        # protocol version, and a junk frame — each must be rejected with a
+        # hello-ack naming the reason, without hurting the real world.
+        hellos = (struct.pack("<iii", 99, 2, 2),   # out-of-range rank
+                  struct.pack("<iii", 0, 2, 2),    # duplicate rank 0
+                  struct.pack("<iii", 1, 5, 2),    # world-size mismatch
+                  struct.pack("<iii", 1, 2, 99),   # protocol mismatch
+                  b"xx")                           # junk
+        for hello in hellos:
+            try:
+                s = socket_mod.create_connection(("127.0.0.1", port),
+                                                 timeout=5)
+                s.sendall(struct.pack("<Q", len(hello)) + hello)
+                s.settimeout(5)
+                s.recv(4096)  # coordinator answers the ack before closing
+                s.close()
+            except OSError:
+                pass
+
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        if rank == 1:
+            time.sleep(1.0)  # let the stray hellos land first
+        c = CoordClient(rank, 2, "127.0.0.1", {port})
+        out = np.asarray(c.collective(
+            "allreduce", np.ones(3, np.float32), "t.ok"))
+        assert np.allclose(out, 2.0), out
+        print(f"rank {{rank}}: OK", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    # Rank 0 hosts the coordinator; give it a moment to bind, then harass.
+    import time
+    time.sleep(0.8)
+    t = threading.Thread(target=_harass)
+    t.start()
+    t.join()
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: OK" in out
+
+
+def test_world_size_mismatch_fails_fast_with_message():
+    """A rank launched with the wrong HVD_SIZE must fail at init() with a
+    message naming the mismatch — not hang until the stall window (the
+    init-time analog of the reference's cross-rank placement validation,
+    mpi_ops.cc:439-449)."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import TransportError
+
+        rank = int(os.environ["HVD_RANK"])
+        size = int(os.environ["HVD_SIZE"])
+        try:
+            c = CoordClient(rank, size, "127.0.0.1", {port})
+        except TransportError as e:
+            assert "world size mismatch" in str(e), e
+            print(f"rank {{rank}}: MISMATCH_DETECTED", flush=True)
+            sys.exit(0)
+        out = np.asarray(c.collective(
+            "allreduce", np.ones(2, np.float32), "t.ok"))
+        assert np.allclose(out, 2.0), out
+        print(f"rank {{rank}}: OK", flush=True)
+        c.shutdown()
+    """)
+    # Coordinator world is size 2; rank 1 joins twice — once with the wrong
+    # size (rejected), then with the right one (admitted).
+    cfgs = [(0, 2), (1, 5), (1, 2)]
+    procs = []
+    for i, (rank, size) in enumerate(cfgs):
+        env = dict(os.environ, HVD_RANK=str(rank), HVD_SIZE=str(size),
+                   PYTHONPATH="", JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        import time
+        time.sleep(0.5)  # deterministic join order
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert "MISMATCH_DETECTED" in outs[1], outs[1]
+    assert "rank 0: OK" in outs[0], outs[0]
+    assert "rank 1: OK" in outs[2], outs[2]
