@@ -35,15 +35,49 @@ from horovod_tpu import models, training
 # Reference baseline: 1656.82 images/sec on 16 GPUs (docs/benchmarks.md:24-54).
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16
 
+# Analytic FLOPs model: ResNet-50 @224 forward ≈ 4.09 GFLOP/image
+# (multiply-accumulate = 2 FLOPs); training step ≈ 3× forward (backward
+# does ~2× the forward work). Lets the JSON line report TFLOP/s and MFU so
+# the number is judgeable against the chip's peak, not just a 2017 GPU.
+TRAIN_GFLOP_PER_IMAGE = {"resnet50": 3 * 4.09, "cifar20": 3 * 0.082}
+
+# Peak dense bf16 TFLOP/s per chip by device kind (public specs; the
+# denominators for MFU).
+_PEAK_TFLOPS = (
+    ("v5 lite", 197.0),   # v5e
+    ("v6 lite", 918.0),   # v6e / Trillium
+    ("v5p", 459.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _peak_tflops_per_chip():
+    if jax.default_backend() != "tpu":
+        return None
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
 
 def _bench_config():
     smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
     on_tpu = jax.default_backend() == "tpu"
     if smoke or not on_tpu:
+        # No scan off-TPU: compiling the scanned step on the virtual CPU
+        # mesh costs minutes and there is no dispatch overhead to amortize.
         return dict(model="cifar20", image=64, batch_per_chip=16,
-                    warmup=2, iters=5, classes=10)
+                    warmup=2, iters=5, classes=10, steps_per_call=1)
+    # steps_per_call: lax.scan over k steps inside one dispatch — amortizes
+    # the per-call host->device dispatch overhead (measured ~4-5 ms on the
+    # axon tunnel; worth ~+4% at 50 ms steps) exactly like
+    # tf_cnn_benchmarks' in-graph loop over synthetic data.
     return dict(model="resnet50", image=224, batch_per_chip=128,
-                warmup=5, iters=20, classes=1000)
+                warmup=5, iters=4, classes=1000, steps_per_call=8)
 
 
 def measure(devices=None, cfg=None) -> float:
@@ -57,12 +91,13 @@ def measure(devices=None, cfg=None) -> float:
     batch = cfg["batch_per_chip"] * n
     image, classes = cfg["image"], cfg["classes"]
 
+    # Local (per-replica) BatchNorm, as in the reference and the Goyal
+    # recipe: cross-replica BN (axis_name=) is opt-in — it changes the
+    # semantics and adds ~50 collectives per ResNet-50 step at scale.
     if cfg["model"] == "resnet50":
-        model = models.resnet50(num_classes=classes, dtype=jnp.bfloat16,
-                                axis_name=hvd.AXIS)
+        model = models.resnet50(num_classes=classes, dtype=jnp.bfloat16)
     else:
-        model = models.cifar_resnet_v1(20, dtype=jnp.float32,
-                                       axis_name=hvd.AXIS)
+        model = models.cifar_resnet_v1(20, dtype=jnp.float32)
 
     x_shape = (batch, image, image, 3)
     # Init from a per-chip-sized sample: flax init runs a real forward pass
@@ -118,20 +153,39 @@ def measure(devices=None, cfg=None) -> float:
         jax.make_array_from_callback((batch,), sharding, _shard_labels),
     )
 
+    k = int(cfg.get("steps_per_call", 1))
+    if k > 1:
+        def _body(s, _):
+            s2, m = step(s, data)
+            return s2, m["loss"]
+
+        @jax.jit
+        def _multi(s):
+            s2, losses = jax.lax.scan(_body, s, None, length=k)
+            return s2, losses[-1]
+
+        def run_once(s):
+            s2, loss = _multi(s)
+            return s2, loss
+    else:
+        def run_once(s):
+            s2, m = step(s, data)
+            return s2, m["loss"]
+
     for _ in range(cfg["warmup"]):
-        state, metrics = step(state, data)
-    float(metrics["loss"])  # full device->host sync before timing
+        state, loss = run_once(state)
+    float(loss)  # full device->host sync before timing
 
     t0 = time.perf_counter()
     for _ in range(cfg["iters"]):
-        state, metrics = step(state, data)
+        state, loss = run_once(state)
     # End the timed region with an explicit host transfer: on experimental
     # backends block_until_ready alone has been observed to return before
     # the dispatch queue drains, inflating throughput ~15x.
-    final_loss = float(metrics["loss"])
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
-    return batch * cfg["iters"] / dt
+    return batch * cfg["iters"] * k / dt
 
 
 def main() -> None:
@@ -186,12 +240,18 @@ def main() -> None:
 
     rate = measure(cfg=cfg)
     per_chip = rate / hvd.size()
-    print(json.dumps({
+    line = {
         "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
-    }))
+    }
+    tflops = per_chip * TRAIN_GFLOP_PER_IMAGE[cfg["model"]] / 1e3
+    line["tflops_per_chip"] = round(tflops, 1)
+    peak = _peak_tflops_per_chip()
+    if peak:
+        line["mfu"] = round(tflops / peak, 3)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
