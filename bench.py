@@ -32,14 +32,26 @@ import optax
 import horovod_tpu as hvd
 from horovod_tpu import models, training
 
-# Reference baseline: 1656.82 images/sec on 16 GPUs (docs/benchmarks.md:24-54).
+# Reference baseline: 1656.82 images/sec on 16 GPUs running ResNet-101
+# (docs/benchmarks.md:24-54) — the reference's only absolute throughput.
+# For other models the per-GPU baseline is FLOPs-scaled from it (the
+# reference GPU's estimated rate on that model), so vs_baseline stays an
+# apples-to-apples hardware ratio rather than crediting cheaper models.
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16
 
-# Analytic FLOPs model: ResNet-50 @224 forward ≈ 4.09 GFLOP/image
-# (multiply-accumulate = 2 FLOPs); training step ≈ 3× forward (backward
-# does ~2× the forward work). Lets the JSON line report TFLOP/s and MFU so
-# the number is judgeable against the chip's peak, not just a 2017 GPU.
-TRAIN_GFLOP_PER_IMAGE = {"resnet50": 3 * 4.09, "cifar20": 3 * 0.082}
+
+def _baseline_for(model: str) -> float:
+    return BASELINE_IMG_PER_SEC_PER_DEVICE * (
+        _FWD_GMACS["resnet101"] / _FWD_GMACS[model])
+
+# Analytic FLOPs model: forward GMACs per image × 2 (multiply-accumulate =
+# 2 FLOPs — the convention XLA's own cost analysis uses; its estimate for
+# the ResNet-50 train step, 23.9 GFLOP/img, matches this model) × 3
+# (backward ≈ 2× forward). Lets the JSON line report TFLOP/s and MFU so the
+# number is judgeable against the chip's peak, not just a 2017 GPU.
+_FWD_GMACS = {"resnet50": 4.09, "resnet101": 7.80, "vgg16": 15.47,
+              "inception3": 5.73, "cifar20": 0.041}
+TRAIN_GFLOP_PER_IMAGE = {k: 3 * 2 * v for k, v in _FWD_GMACS.items()}
 
 # Peak dense bf16 TFLOP/s per chip by device kind (public specs; the
 # denominators for MFU).
@@ -64,7 +76,23 @@ def _peak_tflops_per_chip():
     return None
 
 
-def _bench_config():
+# Per-model TPU configs (the reference benchmark family, tf_cnn_benchmarks
+# --model {resnet50, resnet101, vgg16, inception3}; docs/benchmarks.md:5-6).
+_TPU_CONFIGS = {
+    "resnet50": dict(model="resnet50", image=224, batch_per_chip=128,
+                     warmup=5, iters=4, classes=1000, steps_per_call=8),
+    "resnet101": dict(model="resnet101", image=224, batch_per_chip=96,
+                      warmup=5, iters=4, classes=1000, steps_per_call=8),
+    # VGG has no BN: classic SGD needs the small-lr recipe or it blows up.
+    "vgg16": dict(model="vgg16", image=224, batch_per_chip=96,
+                  warmup=5, iters=4, classes=1000, steps_per_call=8,
+                  lr=0.01),
+    "inception3": dict(model="inception3", image=299, batch_per_chip=96,
+                       warmup=5, iters=4, classes=1000, steps_per_call=8),
+}
+
+
+def _bench_config(model: str = "resnet50"):
     smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
     on_tpu = jax.default_backend() == "tpu"
     if smoke or not on_tpu:
@@ -76,8 +104,25 @@ def _bench_config():
     # the per-call host->device dispatch overhead (measured ~4-5 ms on the
     # axon tunnel; worth ~+4% at 50 ms steps) exactly like
     # tf_cnn_benchmarks' in-graph loop over synthetic data.
-    return dict(model="resnet50", image=224, batch_per_chip=128,
-                warmup=5, iters=4, classes=1000, steps_per_call=8)
+    return dict(_TPU_CONFIGS[model])
+
+
+def _build_model(cfg):
+    """Benchmark models use local (per-replica) BatchNorm — the reference /
+    Goyal configuration; cross-replica BN is opt-in via axis_name."""
+    name = cfg["model"]
+    if name == "resnet50":
+        return models.resnet50(num_classes=cfg["classes"],
+                               dtype=jnp.bfloat16)
+    if name == "resnet101":
+        return models.resnet101(num_classes=cfg["classes"],
+                                dtype=jnp.bfloat16)
+    if name == "vgg16":
+        return models.vgg16(num_classes=cfg["classes"], dtype=jnp.bfloat16)
+    if name == "inception3":
+        return models.inception_v3(num_classes=cfg["classes"],
+                                   dtype=jnp.bfloat16)
+    return models.cifar_resnet_v1(20, dtype=jnp.float32)
 
 
 def measure(devices=None, cfg=None) -> float:
@@ -91,13 +136,7 @@ def measure(devices=None, cfg=None) -> float:
     batch = cfg["batch_per_chip"] * n
     image, classes = cfg["image"], cfg["classes"]
 
-    # Local (per-replica) BatchNorm, as in the reference and the Goyal
-    # recipe: cross-replica BN (axis_name=) is opt-in — it changes the
-    # semantics and adds ~50 collectives per ResNet-50 step at scale.
-    if cfg["model"] == "resnet50":
-        model = models.resnet50(num_classes=classes, dtype=jnp.bfloat16)
-    else:
-        model = models.cifar_resnet_v1(20, dtype=jnp.float32)
+    model = _build_model(cfg)
 
     x_shape = (batch, image, image, 3)
     # Init from a per-chip-sized sample: flax init runs a real forward pass
@@ -105,7 +144,7 @@ def measure(devices=None, cfg=None) -> float:
     state, dist_opt = training.create_train_state(
         model, jax.random.PRNGKey(0),
         jnp.zeros((cfg["batch_per_chip"],) + x_shape[1:], jnp.float32),
-        optax.sgd(0.1, momentum=0.9))
+        optax.sgd(cfg.get("lr", 0.1), momentum=0.9))
     step = training.make_train_step(model, dist_opt)
 
     # Materialize only local shards (a host-side global batch would be
@@ -159,7 +198,12 @@ def measure(devices=None, cfg=None) -> float:
             s2, m = step(s, data)
             return s2, m["loss"]
 
-        @jax.jit
+        import functools
+
+        # Donate the carried state: the inner step's donation is ignored
+        # when traced under this jit, and an undonated TrainState copy
+        # (~1 GB for VGG-16) would sit in HBM for the whole dispatch.
+        @functools.partial(jax.jit, donate_argnums=0)
         def _multi(s):
             s2, losses = jax.lax.scan(_body, s, None, length=k)
             return s2, losses[-1]
@@ -193,8 +237,13 @@ def main() -> None:
     p.add_argument("--scaling", action="store_true",
                    help="measure world sizes 1,2,4,... and report "
                         "scaling efficiency per size")
+    p.add_argument("--model", default="resnet50",
+                   choices=sorted(_TPU_CONFIGS),
+                   help="benchmark model (the reference's "
+                        "tf_cnn_benchmarks family; ignored in smoke/CPU "
+                        "mode)")
     args = p.parse_args()
-    cfg = _bench_config()
+    cfg = _bench_config(args.model)
 
     if args.scaling:
         # Scaling mode is single-controller only: it re-inits the world with
@@ -233,7 +282,7 @@ def main() -> None:
             "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
             "value": round(per_chip, 2),
             "unit": "images/sec/chip",
-            "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE,
+            "vs_baseline": round(per_chip / _baseline_for(cfg["model"]),
                                  3),
         }))
         return
@@ -244,7 +293,7 @@ def main() -> None:
         "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+        "vs_baseline": round(per_chip / _baseline_for(cfg["model"]), 3),
     }
     tflops = per_chip * TRAIN_GFLOP_PER_IMAGE[cfg["model"]] / 1e3
     line["tflops_per_chip"] = round(tflops, 1)

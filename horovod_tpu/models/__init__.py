@@ -1,7 +1,8 @@
-"""Model families matching the reference's example workloads
-(``examples/``: MNIST CNNs, CIFAR ResNet v1/v2, ImageNet ResNet-50,
-skip-gram word2vec), implemented as flax.linen modules designed for the MXU
-(bfloat16 activations, static shapes, XLA-fusable blocks)."""
+"""Model families matching the reference's example workloads and benchmark
+table (``examples/``: MNIST CNNs, CIFAR ResNet v1/v2, ImageNet ResNet-50,
+skip-gram word2vec; ``docs/benchmarks.md``: Inception V3, ResNet-101,
+VGG-16), implemented as flax.linen modules designed for the MXU (bfloat16
+activations, static shapes, XLA-fusable blocks)."""
 
 from .mnist import MnistCNN  # noqa: F401
 from .resnet import (  # noqa: F401
@@ -14,4 +15,6 @@ from .resnet import (  # noqa: F401
     resnet50,
     resnet101,
 )
+from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .inception import InceptionV3, inception_v3  # noqa: F401
 from .word2vec import SkipGram, embedding_grads_as_slices  # noqa: F401

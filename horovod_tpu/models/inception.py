@@ -1,0 +1,185 @@
+"""Inception V3 — the reference's headline benchmark model.
+
+Reference parity: Inception V3 leads the reference's 128-GPU scaling table
+(90% efficiency — ``README.md:21-26``, ``docs/benchmarks.md:5-6``),
+benchmarked via ``tf_cnn_benchmarks --model inception3``. Architecture per
+Szegedy et al. (arXiv:1512.00567) as realized by tf.slim's ``inception_v3``
+(the implementation tf_cnn_benchmarks used): BN after every conv, factorized
+7×7 branches in the 17×17 stages, expanded 3×3 splits in the 8×8 stages.
+
+TPU-native design: flax module, bf16 activations / f32 params; the many
+small parallel branches are exactly the fusion-friendly graph XLA schedules
+well on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv → BN → relu, the slim ``conv2d`` unit of inception_v3."""
+
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    """35×35 mixed block (slim Mixed_5b/5c/5d)."""
+
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64)(x, train)
+        b5 = cbn(48)(x, train)
+        b5 = cbn(64, (5, 5))(b5, train)
+        b3 = cbn(64)(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(self.pool_features)(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    """35→17 grid reduction (slim Mixed_6a)."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        bd = cbn(64)(x, train)
+        bd = cbn(96, (3, 3))(bd, train)
+        bd = cbn(96, (3, 3), (2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """17×17 mixed block with factorized 7×7 (slim Mixed_6b..6e)."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = cbn(192)(x, train)
+        b7 = cbn(c)(x, train)
+        b7 = cbn(c, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        bd = cbn(c)(x, train)
+        bd = cbn(c, (7, 1))(bd, train)
+        bd = cbn(c, (1, 7))(bd, train)
+        bd = cbn(c, (7, 1))(bd, train)
+        bd = cbn(192, (1, 7))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192)(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    """17→8 grid reduction (slim Mixed_7a)."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192)(x, train)
+        b3 = cbn(320, (3, 3), (2, 2), padding="VALID")(b3, train)
+        b7 = cbn(192)(x, train)
+        b7 = cbn(192, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        b7 = cbn(192, (3, 3), (2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """8×8 mixed block with expanded 3×3 splits (slim Mixed_7b/7c)."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320)(x, train)
+        b3 = cbn(384)(x, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        bd = cbn(448)(x, train)
+        bd = cbn(384, (3, 3))(bd, train)
+        bd = jnp.concatenate([cbn(384, (1, 3))(bd, train),
+                              cbn(384, (3, 1))(bd, train)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192)(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 for 299×299 inputs (224 also works — global pool)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem (slim Conv2d_1a..MaxPool_5a).
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80)(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35×35.
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        # 17×17.
+        x = InceptionB(128, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(192, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        # 8×8.
+        x = InceptionC(dtype=self.dtype)(x, train)
+        x = InceptionC(dtype=self.dtype)(x, train)
+        # Head.
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def inception_v3(num_classes: int = 1000, **kw) -> InceptionV3:
+    """Inception V3 (reference headline model, ``docs/benchmarks.md:5-6``)."""
+    return InceptionV3(num_classes=num_classes, **kw)

@@ -1,0 +1,70 @@
+"""VGG — the reference's third benchmark family.
+
+Reference parity: VGG-16 is one of the three models in the reference's
+headline 128-GPU scaling table (79% efficiency — ``README.md:26``,
+``docs/benchmarks.md:6``), benchmarked via ``tf_cnn_benchmarks
+--model vgg16``. VGG's huge dense head (~120M of its ~138M params) is what
+drags its allreduce scaling below the convnets' 90% — which makes it the
+stress model for gradient-fusion bandwidth.
+
+TPU-native design: flax module, bf16 activations / f32 params like the
+ResNets; the conv stacks are plain 3×3/SAME chains XLA tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Convs per stage (filters double per stage up to 512).
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class VGG(nn.Module):
+    """VGG-D family (11/13/16/19 layers) for 224×224 inputs."""
+
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dense_features: Sequence[int] = (4096, 4096)
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.depth not in _CFG:
+            raise ValueError(
+                f"VGG depth must be one of {sorted(_CFG)}; got {self.depth}")
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                                 dtype=self.dtype)
+        x = x.astype(self.dtype)
+        filters = 64
+        for stage, n_convs in enumerate(_CFG[self.depth]):
+            for i in range(n_convs):
+                x = nn.relu(conv(min(filters, 512),
+                                 name=f"conv{stage + 1}_{i + 1}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            filters *= 2
+        x = x.reshape((x.shape[0], -1))
+        for i, feats in enumerate(self.dense_features):
+            x = nn.relu(nn.Dense(feats, dtype=self.dtype,
+                                 name=f"fc{i + 6}")(x))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # Final logits in float32 for a numerically stable softmax/loss.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def vgg16(num_classes: int = 1000, **kw) -> VGG:
+    """VGG-16 (reference benchmark model, ``docs/benchmarks.md:6``)."""
+    return VGG(depth=16, num_classes=num_classes, **kw)
+
+
+def vgg19(num_classes: int = 1000, **kw) -> VGG:
+    return VGG(depth=19, num_classes=num_classes, **kw)

@@ -127,6 +127,37 @@ def main():
                      * total)[rank * 3:(rank + 1) * 3]
         assert np.allclose(out_rs, expect_rs), (out_rs, expect_rs)
 
+        # Concurrent submits from MANY THREADS of one rank (the reference's
+        # model: TF executor threads all calling ComputeAsync at once,
+        # mpi_ops.cc:1752-1772) — client must be thread-safe and every op
+        # must complete with its own correct result.
+        import threading
+        results = {}
+        errors = []
+
+        def _thread_op(i):
+            try:
+                out = np.asarray(client.collective(
+                    "allreduce", np.full((16,), float(i), np.float32),
+                    f"t.thread.{i}"))
+                results[i] = out
+            except Exception as e:  # surfaced below
+                errors.append((i, e))
+
+        # daemon: a regression that blocks a thread must fail the assert
+        # below, not hang the process past the assertion.
+        threads = [threading.Thread(target=_thread_op, args=(i,),
+                                    daemon=True)
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 8, sorted(results)
+        for i, out in results.items():
+            assert np.allclose(out, i * size), (i, out)
+
         # Negative tests need >1 rank to produce a mismatch; self-skip at
         # size 1 like the reference's (mpi_ops_test.py:291-293).
         if size > 1:
