@@ -110,6 +110,24 @@ def main():
             # them ready together and fuses within the 64 MiB threshold.)
             assert resp_delta < ops_delta, (resp_delta, ops_delta)
 
+        # Async allgather (ragged) + broadcast interleaved with allreduces:
+        # mixed-kind handles must complete out-of-order with correct
+        # shapes/sizes (allgather's negotiated per-rank dims ride the same
+        # wait path).
+        hg = client.submit("allgather",
+                           np.full((rank + 1, 2), float(rank), np.float32),
+                           "t.async.g")
+        hb = client.submit("broadcast", np.arange(3, dtype=np.float64) * 2
+                           if rank == 0 else np.zeros(3, np.float64),
+                           "t.async.b", root_rank=0)
+        ha = client.submit("allreduce", np.ones(4, np.float32), "t.async.a")
+        out_a = np.asarray(client.wait(ha))          # reverse order
+        out_b = np.asarray(client.wait(hb))
+        out_g = np.asarray(client.wait(hg))
+        assert np.allclose(out_a, float(size)), out_a
+        assert np.allclose(out_b, np.arange(3) * 2), out_b
+        assert out_g.shape == (sum(r + 1 for r in range(size)), 2), out_g
+
         # Eager alltoall: rank r sends block s to rank s; receives block r
         # of every rank (lax.all_to_all semantics).
         a2a = np.arange(size * 2, dtype=np.float32) + 100.0 * rank
