@@ -1,0 +1,117 @@
+"""Hook/Estimator integration tests (the reference's Estimator +
+SessionRunHook pattern, ``tensorflow_mnist_estimator.py:145-191``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import models, training
+from horovod_tpu.hooks import (BroadcastGlobalVariablesHook,
+                               CheckpointSaverHook, Estimator, LoggingHook,
+                               MonitoredTrainingLoop, StopAtStepHook,
+                               TrainingHook)
+
+
+def _toy_batch(n=16, key=0):
+    rng = np.random.RandomState(key)
+    x = rng.randn(n, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _make_step(lr=0.05):
+    model = models.MnistCNN()
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 784)), optax.sgd(lr))
+    return training.make_train_step(model, dist_opt), state
+
+
+class TestMonitoredTrainingLoop:
+    def test_hooks_fire_in_order(self):
+        step, state = _make_step()
+        calls = []
+
+        class Recorder(TrainingHook):
+            def begin(self, loop):
+                calls.append("begin")
+
+            def after_create_session(self, loop):
+                calls.append("acs")
+
+            def before_run(self, loop, s):
+                calls.append(f"before{s}")
+
+            def after_run(self, loop, s, metrics):
+                calls.append(f"after{s}")
+                assert "loss" in metrics
+
+            def end(self, loop):
+                calls.append("end")
+
+        loop = MonitoredTrainingLoop(step, state, [Recorder()])
+        loop.run([_toy_batch()] * 2)
+        assert calls == ["begin", "acs", "before0", "after0",
+                         "before1", "after1", "end"]
+        assert loop.global_step == 2
+
+    def test_stop_at_step(self):
+        step, state = _make_step()
+        loop = MonitoredTrainingLoop(step, state, [StopAtStepHook(3)])
+        loop.run([_toy_batch()] * 10)
+        assert loop.global_step == 3
+
+    def test_checkpoint_saver_hook(self, tmp_path):
+        from horovod_tpu.trainer import latest_checkpoint_step
+        step, state = _make_step()
+        loop = MonitoredTrainingLoop(
+            step, state,
+            [CheckpointSaverHook(str(tmp_path), save_steps=2),
+             StopAtStepHook(4)])
+        loop.run([_toy_batch()] * 10)
+        # Saves at steps 2, 4, and at end() (state.step == 4).
+        assert latest_checkpoint_step(str(tmp_path)) == 4
+
+
+class TestEstimator:
+    def _estimator(self, model_dir=None):
+        return Estimator(
+            models.MnistCNN(), optax.sgd(0.05), model_dir=model_dir,
+            sample_input=jnp.zeros((2, 784)),
+            metrics_fn=lambda lg, lb: {
+                "accuracy": training.accuracy(lg, lb)})
+
+    def test_train_steps_and_evaluate(self):
+        est = self._estimator()
+        batch = _toy_batch()
+
+        def input_fn():
+            return iter([batch] * 4)
+
+        est.train(input_fn, steps=6,
+                  hooks=[BroadcastGlobalVariablesHook(0),
+                         LoggingHook(every_n_steps=100)])
+        assert int(est.state.step) == 6  # stream repeats until StopAtStep
+        metrics = est.evaluate(input_fn)
+        assert set(metrics) == {"loss", "accuracy"}
+        assert np.isfinite(metrics["loss"])
+
+    def test_train_learns(self):
+        est = self._estimator()
+        batch = _toy_batch(32)
+
+        def input_fn():
+            return iter([batch] * 8)
+
+        before = est.evaluate(input_fn)["loss"]
+        est.train(input_fn, steps=16)
+        after = est.evaluate(input_fn)["loss"]
+        assert after < before, (before, after)
+
+    def test_model_dir_checkpoints(self, tmp_path):
+        from horovod_tpu.trainer import latest_checkpoint_step
+        est = self._estimator(model_dir=str(tmp_path))
+        est.train(lambda: iter([_toy_batch()] * 3), steps=3)
+        assert latest_checkpoint_step(str(tmp_path)) == 3
