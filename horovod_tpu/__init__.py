@@ -47,6 +47,7 @@ from .ops.collectives import (  # noqa: F401
 )
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .optimizer import (  # noqa: F401
+    Compression,
     DistributedOptimizer,
     allreduce_gradients,
     broadcast_global_variables,
@@ -54,6 +55,7 @@ from .optimizer import (  # noqa: F401
     broadcast_optimizer_state,
 )
 from . import callbacks  # noqa: F401
+from . import data  # noqa: F401
 from . import hooks  # noqa: F401
 from .hooks import BroadcastGlobalVariablesHook  # noqa: F401
 from . import models  # noqa: F401

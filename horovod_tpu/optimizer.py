@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from . import runtime
@@ -49,11 +50,46 @@ def _is_sparse_leaf(x) -> bool:
     return isinstance(x, IndexedSlices)
 
 
+class Compression:
+    """Gradient compression for the cross-chip allreduce.
+
+    TPU-era extra (no analog in reference v0.11.2; later Horovod grew
+    ``Compression.fp16``): ``Compression.bf16`` casts float gradients wider
+    than 16 bits to bfloat16 — the MXU/ICI-native 16-bit type — before the
+    fused allreduce and restores the original dtype after, halving
+    interconnect bytes per step. Accumulation inside the XLA all-reduce is
+    f32 on TPU, so the loss of precision is the single round-trip cast.
+    """
+
+    class none:  # noqa: N801 — enum-style namespace
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class bf16:  # noqa: N801
+        @staticmethod
+        def compress(t):
+            if (hasattr(t, "dtype")
+                    and jnp.issubdtype(t.dtype, jnp.floating)
+                    and jnp.dtype(t.dtype).itemsize > 2):
+                return t.astype(jnp.bfloat16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t.astype(ctx) if ctx is not None else t
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *,
                          average: bool = True,
                          fusion_threshold: Optional[int] = None,
                          sparse_as_dense: bool = False,
+                         compression: Any = Compression.none,
                          axis_name: str = AXIS
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with fused gradient allreduce.
@@ -62,6 +98,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     127-186``) — gradients are averaged across ranks before being applied;
     a no-op when ``size() == 1`` (``__init__.py:180-182``). Call inside the
     jitted train step under ``shard_map`` over the world mesh.
+    ``compression=Compression.bf16`` halves allreduce bytes (see
+    :class:`Compression`).
     """
     def init_fn(params):
         return optimizer.init(params)
@@ -69,7 +107,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     def update_fn(grads, state, params=None, **extra):
         grads = allreduce_gradients(
             grads, average=average, fusion_threshold=fusion_threshold,
-            sparse_as_dense=sparse_as_dense, axis_name=axis_name)
+            sparse_as_dense=sparse_as_dense, compression=compression,
+            axis_name=axis_name)
         return optimizer.update(grads, state, params, **extra)
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -79,6 +118,7 @@ def allreduce_gradients(grads,
                         average: bool = True,
                         fusion_threshold: Optional[int] = None,
                         sparse_as_dense: bool = False,
+                        compression: Any = Compression.none,
                         axis_name: str = AXIS):
     """Allreduce a gradient pytree: dense leaves via fused flat buckets,
     sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``)."""
@@ -90,11 +130,32 @@ def allreduce_gradients(grads,
         grads = jax.tree_util.tree_map(
             lambda l: l.to_dense() if _is_sparse_leaf(l) else l,
             grads, is_leaf=_is_sparse_leaf)
+
+    # Structural (tree_map) compression round-trip: the ctx tree mirrors the
+    # gradient tree leaf-for-leaf (wrapped in an opaque holder so a None ctx
+    # is still a leaf), so restoration cannot depend on flatten ordering.
+    class _Ctx:
+        __slots__ = ("dtype",)
+
+        def __init__(self, dtype):
+            self.dtype = dtype
+
+    ctx_tree = jax.tree_util.tree_map(
+        lambda l: _Ctx(None if _is_sparse_leaf(l)
+                       else compression.compress(l)[1]),
+        grads, is_leaf=_is_sparse_leaf)
+    compressed = jax.tree_util.tree_map(
+        lambda l: l if _is_sparse_leaf(l) else compression.compress(l)[0],
+        grads, is_leaf=_is_sparse_leaf)
     # fused_allreduce buckets dense leaves and routes IndexedSlices leaves
     # through the two-allgather sparse path.
-    return fused_allreduce(grads, average=average,
-                           fusion_threshold=fusion_threshold,
-                           axis_name=axis_name)
+    reduced = fused_allreduce(compressed, average=average,
+                              fusion_threshold=fusion_threshold,
+                              axis_name=axis_name)
+    return jax.tree_util.tree_map(
+        lambda l, c: l if _is_sparse_leaf(l)
+        else compression.decompress(l, c.dtype),
+        reduced, ctx_tree, is_leaf=_is_sparse_leaf)
 
 
 def broadcast_global_variables(variables, root_rank: int = 0,

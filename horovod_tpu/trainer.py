@@ -121,10 +121,16 @@ class Trainer:
 # ---------------------------------------------------------------------------
 
 def save_checkpoint(directory: str, state: TrainState,
-                    step: Optional[int] = None) -> Optional[str]:
+                    step: Optional[int] = None,
+                    max_to_keep: Optional[int] = None) -> Optional[str]:
     """Write a checkpoint — rank 0 only, like the reference
     (``checkpoint_dir=None`` on other ranks, ``README.md:78-80``).
-    Returns the path written, or None on non-root ranks."""
+    Returns the path written, or None on non-root ranks.
+
+    ``max_to_keep``: after a successful write, delete the oldest
+    checkpoints beyond the newest ``max_to_keep`` (retention is the
+    writer's job since only rank 0 touches the directory).
+    """
     if runtime.is_initialized() and runtime.world().controller_rank != 0:
         return None
     import orbax.checkpoint as ocp
@@ -132,7 +138,35 @@ def save_checkpoint(directory: str, state: TrainState,
     path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, jax.tree_util.tree_map(np.asarray, state), force=True)
+    if max_to_keep is not None and max_to_keep > 0:
+        import shutil
+        # Retention by WRITE recency, not step number: a run resumed from a
+        # rolled-back step must never have its just-written checkpoint
+        # deleted in favor of stale higher-step leftovers.
+        base = os.path.abspath(directory)
+        entries = []
+        for n in os.listdir(base):
+            if _step_of(n) is None:
+                continue
+            full = os.path.join(base, n)
+            try:
+                entries.append((os.path.getmtime(full), full))
+            except OSError:
+                continue
+        entries.sort()
+        for _, old in entries[:-max_to_keep]:
+            if old != path:
+                shutil.rmtree(old, ignore_errors=True)
     return path
+
+
+def _step_of(name: str) -> Optional[int]:
+    if not name.startswith("ckpt_"):
+        return None
+    try:
+        return int(name.split("_", 1)[1])
+    except ValueError:
+        return None
 
 
 def latest_checkpoint_step(directory: str) -> Optional[int]:
@@ -140,13 +174,8 @@ def latest_checkpoint_step(directory: str) -> Optional[int]:
     before broadcasting the epoch, ``keras_imagenet_resnet50.py:47-56``)."""
     if not os.path.isdir(directory):
         return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("ckpt_"):
-            try:
-                steps.append(int(name.split("_", 1)[1]))
-            except ValueError:
-                continue
+    steps = [s for s in (_step_of(n) for n in os.listdir(directory))
+             if s is not None]
     return max(steps) if steps else None
 
 

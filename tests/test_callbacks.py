@@ -160,3 +160,27 @@ class TestCheckpoint:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6)
         assert int(restored.step) == 1
+
+    def test_retention_keeps_newest(self, tmp_path):
+        _, state, _ = _mnist_setup()
+        for s in range(5):
+            trainer_mod.save_checkpoint(str(tmp_path), state, step=s,
+                                        max_to_keep=2)
+        kept = sorted(n for n in os.listdir(tmp_path)
+                      if n.startswith("ckpt_"))
+        assert kept == ["ckpt_3", "ckpt_4"], kept
+        assert trainer_mod.latest_checkpoint_step(str(tmp_path)) == 4
+
+    def test_retention_survives_rollback_resume(self, tmp_path):
+        """Resuming from a rolled-back step: the just-written (lower-step)
+        checkpoint must survive retention; stale higher-step leftovers go
+        first (retention is by write recency, not step number)."""
+        _, state, _ = _mnist_setup()
+        for s in (80, 90, 100):
+            trainer_mod.save_checkpoint(str(tmp_path), state, step=s)
+        path = trainer_mod.save_checkpoint(str(tmp_path), state, step=60,
+                                           max_to_keep=2)
+        assert os.path.exists(path), "just-written checkpoint was deleted"
+        kept = sorted(n for n in os.listdir(tmp_path)
+                      if n.startswith("ckpt_"))
+        assert "ckpt_60" in kept and len(kept) == 2, kept

@@ -42,6 +42,50 @@ def test_distributed_optimizer_averages_gradients():
         np.asarray(out["w"]), 1.0 - 0.1 * mean_grad, rtol=1e-6)
 
 
+def test_bf16_compression_allreduce_close_and_dtype_restored():
+    """Compression.bf16: the allreduce result keeps the original f32 dtype
+    and matches the uncompressed mean within bf16 tolerance; int and bf16
+    leaves pass through untouched."""
+    from horovod_tpu import Compression
+    size = hvd.size()
+    per_rank = np.stack([np.linspace(-2.0, 2.0, 8).astype(np.float32)
+                         * (r + 1) for r in range(size)])
+
+    def reduce(g):
+        return hvd.allreduce_gradients(
+            {"w": g[0],
+             "ib": jnp.asarray([1, 2], jnp.int32),
+             "b16": jnp.asarray([0.5, 0.25], jnp.bfloat16)},
+            compression=Compression.bf16)
+
+    out = jax.jit(jax.shard_map(
+        reduce, mesh=hvd.mesh(), in_specs=P("hvd"), out_specs=P()))(
+        _stacked(per_rank))
+    assert out["w"].dtype == jnp.float32
+    # Integer AVERAGE promotes to float (unified pmean semantics) — the
+    # compression round-trip must not mask that.
+    assert jnp.issubdtype(out["ib"].dtype, jnp.floating)
+    assert out["b16"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               per_rank.mean(axis=0), rtol=2e-2, atol=1e-2)
+
+
+def test_distributed_optimizer_accepts_compression():
+    from horovod_tpu import Compression
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   compression=Compression.bf16)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+
+    def step(_):
+        state = opt.init(params)
+        updates, _ = opt.update({"w": jnp.full((4,), 2.0)}, state, params)
+        return optax.apply_updates(params, updates)
+
+    out = jax.jit(jax.shard_map(
+        step, mesh=hvd.mesh(), in_specs=P(), out_specs=P()))(jnp.zeros(1))
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0 - 0.2, rtol=1e-2)
+
+
 def test_distributed_optimizer_state_is_inner_state():
     """Checkpoint compatibility: wrapped state == inner optax state (the
     analog of the Keras dynamic-subclass trick, keras/__init__.py:81-87)."""
