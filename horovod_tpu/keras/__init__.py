@@ -78,6 +78,7 @@ def broadcast_global_variables(model, root_rank: int = 0) -> None:
 
 
 def DistributedOptimizer(optimizer, *, average: bool = True,
+                         compression=None,
                          name: Optional[str] = None):
     """Wrap a Keras 3 optimizer so gradients are averaged across ranks
     before being applied.
@@ -86,14 +87,19 @@ def DistributedOptimizer(optimizer, *, average: bool = True,
     ``type(optimizer)`` with the same class name, so saved configs/
     checkpoints deserialize with plain Keras when this framework is absent
     (reference: ``keras/__init__.py:81-87``). A no-op wrapper when
-    ``size() == 1``.
+    ``size() == 1``. ``compression=hvd.Compression.bf16`` halves allreduce
+    bytes (same semantics as the core optimizer wrapper).
     """
     import keras
 
+    from ..optimizer import Compression
+
     cls_name = optimizer.__class__.__name__
+    compression = compression if compression is not None else Compression.none
 
     class _Distributed(optimizer.__class__):
         _hvd_average = average
+        _hvd_compression = compression
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             if runtime.is_initialized() and runtime.size() > 1:
@@ -109,8 +115,11 @@ def DistributedOptimizer(optimizer, *, average: bool = True,
             op_name = f"grad.{getattr(var, 'path', var.name)}"
 
             def _reduce_np(g_np):
-                return allreduce(np.asarray(g_np),
-                                 average=self._hvd_average, name=op_name)
+                arr = np.asarray(g_np)
+                c, ctx = self._hvd_compression.compress(arr)
+                out = allreduce(c, average=self._hvd_average, name=op_name)
+                return np.asarray(
+                    self._hvd_compression.decompress(out, ctx))
 
             # Keras compiles train steps per backend; bridge the collective
             # through the backend's host-callback mechanism so it works

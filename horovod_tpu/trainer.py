@@ -28,7 +28,8 @@ class Trainer:
     def __init__(self, train_step: Callable, state: TrainState,
                  *, eval_step: Optional[Callable] = None,
                  steps_per_epoch: Optional[int] = None,
-                 verbose: Optional[bool] = None):
+                 verbose: Optional[bool] = None,
+                 prefetch: int = 2):
         self.train_step = train_step
         self.eval_step = eval_step
         self.state = state
@@ -37,7 +38,17 @@ class Trainer:
             verbose = (not runtime.is_initialized()
                        or runtime.world().controller_rank == 0)
         self.verbose = verbose
+        # Background input staging depth (0 disables): keeps `prefetch`
+        # sharded batches ahead of the step so chips never wait on host
+        # input (see horovod_tpu.data).
+        self.prefetch = prefetch
         self.history: List[Dict[str, float]] = []
+
+    def _stream(self, data: Iterable):
+        from .data import prefetch_to_device, shard_iterator
+        if self.prefetch and self.prefetch > 0:
+            return prefetch_to_device(shard_iterator(data), self.prefetch)
+        return shard_iterator(data)
 
     def fit(self, data: Callable[[], Iterable], epochs: int = 1,
             callbacks: Optional[List] = None,
@@ -67,18 +78,23 @@ class Trainer:
                 cb.on_epoch_begin(epoch)
             nsteps = 0
             epoch_metrics: List[Dict[str, Any]] = []
-            for batch_idx, batch in enumerate(data()):
-                if self.steps_per_epoch is not None \
-                        and batch_idx >= self.steps_per_epoch:
-                    break
-                for cb in callbacks:
-                    cb.on_batch_begin(batch_idx)
-                self.state, metrics = self.train_step(
-                    self.state, shard_batch(batch))
-                epoch_metrics.append(metrics)
-                for cb in callbacks:
-                    cb.on_batch_end(batch_idx)
-                nsteps += 1
+            stream = self._stream(data())
+            try:
+                for batch_idx, batch in enumerate(stream):
+                    if self.steps_per_epoch is not None \
+                            and batch_idx >= self.steps_per_epoch:
+                        break
+                    for cb in callbacks:
+                        cb.on_batch_begin(batch_idx)
+                    self.state, metrics = self.train_step(self.state, batch)
+                    epoch_metrics.append(metrics)
+                    for cb in callbacks:
+                        cb.on_batch_end(batch_idx)
+                    nsteps += 1
+            finally:
+                close = getattr(stream, "close", None)
+                if close is not None:
+                    close()
             if self.steps_per_epoch is None:
                 self.steps_per_epoch = nsteps
 

@@ -46,6 +46,22 @@ class TestDistributedOptimizer:
         assert cfg["learning_rate"] == pytest.approx(0.1)
         assert cfg["momentum"] == pytest.approx(0.9)
 
+    def test_fit_trains_with_bf16_compression(self):
+        import horovod_tpu as hvd
+        model = _tiny_model()
+        model.compile(
+            optimizer=hvd_keras.DistributedOptimizer(
+                keras.optimizers.SGD(learning_rate=0.05),
+                compression=hvd.Compression.bf16),
+            loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        w = rng.randn(4, 3).astype(np.float32)
+        y = np.argmax(x @ w, axis=1)
+        h = model.fit(x, y, epochs=2, batch_size=16, verbose=0)
+        losses = h.history["loss"]
+        assert losses[-1] < losses[0], losses
+
     def test_fit_trains(self):
         model = _tiny_model()
         model.compile(
