@@ -8,6 +8,9 @@ cd "$(dirname "$0")"
 echo "== build native coordination core =="
 make -C horovod_tpu/coord
 
+echo "== native core threaded selftest (plain + ThreadSanitizer) =="
+make -C horovod_tpu/coord selftest tsan
+
 echo "== unit + multi-process test suite (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 
