@@ -44,6 +44,8 @@ from .ops.collectives import (  # noqa: F401
     allgather_async_,
     broadcast_async_,
     synchronize,
+    broadcast_object,
+    allgather_object,
 )
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .optimizer import (  # noqa: F401

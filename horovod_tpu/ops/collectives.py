@@ -436,6 +436,66 @@ def synchronize(handle):
     return handle.client.wait(handle)
 
 
+# ---------------------------------------------------------------------------
+# Object collectives (TPU-era extras; later Horovod's broadcast_object /
+# allgather_object). Arbitrary picklable Python objects ride the eager
+# plane as uint8 payloads — epoch metadata, config dicts, vocabularies.
+# ---------------------------------------------------------------------------
+
+def broadcast_object(obj=None, root_rank: int = 0,
+                     name: Optional[str] = None):
+    """Every process receives the root process's picklable object.
+
+    Object collectives operate over PROCESSES (objects are host-side
+    metadata — resume epochs, config dicts, vocabularies); under a single
+    controller there is one host and this is the identity. Non-root ranks
+    may pass anything (ignored). Two rounds: the payload length first
+    (non-roots cannot know it), then the bytes.
+    """
+    import pickle
+
+    import numpy as np
+
+    w = runtime.world()
+    if w.process_count == 1:
+        return obj
+    base = _auto_name("BroadcastObject", name)
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8) \
+        if w.controller_rank == root_rank else np.zeros(0, np.uint8)
+    n = broadcast(jnp.asarray([payload.size], jnp.int32),
+                  root_rank=root_rank, name=base + ".len")
+    length = int(np.asarray(n)[0])
+    buf = np.zeros(length, np.uint8)
+    buf[:payload.size] = payload[:length]
+    out = broadcast(jnp.asarray(buf), root_rank=root_rank,
+                    name=base + ".bytes")
+    return pickle.loads(np.asarray(out).tobytes())
+
+
+def allgather_object(obj, name: Optional[str] = None) -> list:
+    """Gather every process's picklable object; returns the process-ordered
+    list on all processes (ragged payloads ride the negotiated-size
+    allgather)."""
+    import pickle
+
+    import numpy as np
+
+    w = runtime.world()
+    if w.process_count == 1:
+        return [obj]
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).reshape(-1, 1)
+    base = _auto_name("AllgatherObject", name)
+    lens = np.asarray(allgather(jnp.asarray([payload.shape[0]], jnp.int32),
+                                name=base + ".len"))
+    blob = np.asarray(allgather(jnp.asarray(payload), name=base + ".bytes"))
+    out, off = [], 0
+    for ln in lens.reshape(-1):
+        ln = int(ln)
+        out.append(pickle.loads(blob[off:off + ln].tobytes()))
+        off += ln
+    return out
+
+
 def grouped_allreduce(tensors, average: bool = True,
                       name: Optional[str] = None,
                       fusion_threshold: Optional[int] = None,

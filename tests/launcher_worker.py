@@ -37,6 +37,14 @@ def main():
                                     root_rank=0)
     assert np.allclose(np.asarray(sync["w"]), 0.0)
 
+    # Object collectives (host-side metadata over the eager plane).
+    meta = hvd.broadcast_object(
+        {"epoch": 7, "note": "resume"} if r == 0 else None, root_rank=0)
+    assert meta == {"epoch": 7, "note": "resume"}, meta
+    objs = hvd.allgather_object({"rank": r, "payload": "x" * (r + 1)})
+    assert [o["rank"] for o in objs] == list(range(s)), objs
+    assert objs[-1]["payload"] == "x" * s, objs
+
     # Env-world training: the compiled step's gradient exchange must ride
     # the host plane (split jit-grads -> fused host allreduce -> jit-apply),
     # keeping replicas bit-synchronized — the reference's per-process-TF +
