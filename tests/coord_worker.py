@@ -205,6 +205,24 @@ def main():
             except FailedPreconditionError as e:
                 assert "Mismatched BROADCAST root ranks" in str(e), e
 
+            # A validation error INSIDE an async burst: the bad op must
+            # error on every rank while its fusable neighbors (submitted
+            # concurrently, same drain) still complete correctly — the
+            # error response never fuses or corrupts the batch.
+            hs = [client.submit("allreduce",
+                                np.full((4,), float(i), np.float32),
+                                f"t.mixed.{i}") for i in range(3)]
+            hbad = client.submit(
+                "allreduce", np.zeros((2 + rank,), np.float32), "t.mixed.bad")
+            for i, h in enumerate(hs):
+                out = np.asarray(client.wait(h))
+                assert np.allclose(out, i * size), (i, out)
+            try:
+                client.wait(hbad)
+                raise SystemExit("expected FailedPreconditionError")
+            except FailedPreconditionError as e:
+                assert "Mismatched ALLREDUCE tensor shapes" in str(e), e
+
         print(f"rank {rank}: OK", flush=True)
     finally:
         client.shutdown()
