@@ -34,6 +34,9 @@ def main():
     p.add_argument("--image", type=int, default=64)
     p.add_argument("--classes", type=int, default=100)
     p.add_argument("--ckpt-dir", default="/tmp/hvd_resnet50_ckpt")
+    p.add_argument("--compression", choices=["none", "bf16"], default="none",
+                   help="gradient compression for the allreduce "
+                        "(bf16 halves interconnect bytes at scale)")
     args = p.parse_args()
 
     hvd.init()
@@ -58,7 +61,9 @@ def main():
 
     state, dist_opt = training.create_train_state(
         model, jax.random.PRNGKey(0),
-        jnp.zeros((2, args.image, args.image, 3)), opt)
+        jnp.zeros((2, args.image, args.image, 3)), opt,
+        compression=(hvd.Compression.bf16 if args.compression == "bf16"
+                     else hvd.Compression.none))
     step = training.make_train_step(model, dist_opt)
     eval_step = training.make_eval_step(model)
 

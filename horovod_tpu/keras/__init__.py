@@ -31,6 +31,7 @@ from ..ops.collectives import Op
 from ..ops.collectives import allgather as _allgather
 from ..ops.collectives import allreduce as _allreduce
 from ..ops.collectives import broadcast as _broadcast
+from ..optimizer import Compression  # noqa: F401  (compression= convenience)
 from ..runtime import (  # noqa: F401  (re-exports, reference parity)
     init,
     is_initialized,
@@ -91,8 +92,6 @@ def DistributedOptimizer(optimizer, *, average: bool = True,
     bytes (same semantics as the core optimizer wrapper).
     """
     import keras
-
-    from ..optimizer import Compression
 
     cls_name = optimizer.__class__.__name__
     compression = compression if compression is not None else Compression.none

@@ -36,7 +36,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import runtime
-from .optimizer import DistributedOptimizer
+from .optimizer import Compression, DistributedOptimizer
 from .runtime import AXIS
 
 
@@ -65,6 +65,7 @@ def accuracy(logits, labels):
 def create_train_state(model, rng, sample_input, optimizer,
                        *, average: bool = True,
                        fusion_threshold: Optional[int] = None,
+                       compression: Any = Compression.none,
                        has_batch_stats: Optional[bool] = None,
                        model_kwargs: Optional[dict] = None) -> Tuple[
                            TrainState, optax.GradientTransformation]:
@@ -81,8 +82,9 @@ def create_train_state(model, rng, sample_input, optimizer,
     batch_stats = variables.get("batch_stats")
     if has_batch_stats is not None and not has_batch_stats:
         batch_stats = None
-    dist_opt = DistributedOptimizer(optimizer, average=average,
-                                    fusion_threshold=fusion_threshold)
+    dist_opt = DistributedOptimizer(
+        optimizer, average=average, fusion_threshold=fusion_threshold,
+        compression=compression)
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
