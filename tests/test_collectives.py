@@ -260,6 +260,39 @@ def test_reducescatter_in_trace():
     np.testing.assert_allclose(out, x.sum(axis=0))
 
 
+def test_alltoall_eager_single_controller():
+    """Eager alltoall on a world-sharded array: per-rank block b of rank s
+    lands as slot s of rank b (global view preserved by the out sharding)."""
+    size = hvd.size()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    # Global [size*size]: rank r holds r*size..(r+1)*size-1, block c = one
+    # element; after the exchange rank r holds [c*size+r for c in ranks].
+    x = jax.device_put(np.arange(size * size, dtype=np.float32),
+                       NamedSharding(hvd.mesh(), P(hvd.AXIS)))
+    out = np.asarray(hvd.alltoall(x))
+    expect = np.arange(size * size).reshape(size, size).T.reshape(-1)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_reducescatter_eager_single_controller():
+    size = hvd.size()
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jax.device_put(np.ones(size * size, np.float32),
+                       NamedSharding(hvd.mesh(), P(hvd.AXIS)))
+    out = np.asarray(hvd.reducescatter(x))
+    # Per-rank block [1] = sum over ranks; global out [size].
+    np.testing.assert_allclose(out, np.full((size,), size, np.float32))
+    avg = np.asarray(hvd.reducescatter(x, average=True))
+    np.testing.assert_allclose(avg, np.ones((size,), np.float32))
+
+
+def test_alltoall_eager_requires_sharded_input():
+    with pytest.raises(ValueError, match="sharded over the world axis"):
+        hvd.alltoall(np.ones(hvd.size() ** 2, np.float32))
+
+
 def test_broadcast_repairs_nan_on_nonroot_ranks():
     """Broadcast must deliver the root's values even when non-root ranks
     hold NaN/Inf — re-syncing diverged replicas is its main job (§5.4)."""
