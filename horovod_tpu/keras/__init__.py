@@ -187,3 +187,122 @@ class MetricAverageCallback:
                             name=f"metric.{k}"))
 
         return _CB()
+
+
+class LearningRateScheduleCallback:
+    """Keras callback: LR = ``initial_lr * multiplier(epoch)`` between
+    ``start_epoch`` and ``end_epoch`` (parity:
+    ``horovod/keras/callbacks.py:90-199``). ``staircase=False`` adjusts
+    every batch at fractional epochs; with ``momentum_correction`` the
+    optimizer momentum is scaled by ``new_lr/old_lr`` for the adjusted
+    batch and restored after it."""
+
+    def __new__(cls, multiplier, start_epoch: int = 0,
+                end_epoch: Optional[int] = None, staircase: bool = True,
+                momentum_correction: bool = True,
+                steps_per_epoch: Optional[int] = None):
+        import keras
+
+        mult = multiplier if callable(multiplier) \
+            else (lambda epoch: multiplier)
+
+        class _CB(keras.callbacks.Callback):
+            def __init__(self):
+                super().__init__()
+                self.initial_lr = None
+                self.current_epoch = 0
+                self.restore_momentum = None
+
+            # -- optimizer plumbing (Keras 3 variables) -------------------
+            def _get_lr(self):
+                return float(keras.ops.convert_to_numpy(
+                    self.model.optimizer.learning_rate))
+
+            def _set_lr(self, v):
+                self.model.optimizer.learning_rate = v
+
+            def _momentum(self):
+                m = getattr(self.model.optimizer, "momentum", None)
+                return float(m) if m is not None else None
+
+            def _set_momentum(self, v):
+                self.model.optimizer.momentum = v
+
+            # -- schedule -------------------------------------------------
+            def _adjust(self, epoch):
+                old_lr = self._get_lr()
+                new_lr = self.initial_lr * mult(epoch)
+                self._set_lr(new_lr)
+                m = self._momentum()
+                if momentum_correction and old_lr > 0 and m:
+                    self.restore_momentum = m
+                    self._set_momentum(m * new_lr / old_lr)
+
+            def on_train_begin(self, logs=None):
+                self.initial_lr = self._get_lr()
+                if not staircase and not steps_per_epoch:
+                    raise ValueError(
+                        "steps_per_epoch is required for staircase=False "
+                        "(smooth per-batch adjustment)")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self.current_epoch = epoch
+
+            def on_train_batch_begin(self, batch, logs=None):
+                if (self.current_epoch < start_epoch
+                        or (end_epoch is not None
+                            and self.current_epoch >= end_epoch)):
+                    return
+                if staircase and batch == 0:
+                    self._adjust(self.current_epoch)
+                elif not staircase:
+                    self._adjust(self.current_epoch
+                                 + float(batch) / steps_per_epoch)
+
+            def on_train_batch_end(self, batch, logs=None):
+                if self.restore_momentum is not None:
+                    self._set_momentum(self.restore_momentum)
+                    self.restore_momentum = None
+
+            def on_epoch_end(self, epoch, logs=None):
+                if logs is not None:
+                    logs["lr"] = self._get_lr()
+
+        return _CB()
+
+
+class LearningRateWarmupCallback:
+    """Keras callback: gradual warmup ``lr/size → lr`` over
+    ``warmup_epochs`` (parity: ``horovod/keras/callbacks.py:202-259``;
+    Goyal et al. 1706.02677)."""
+
+    def __new__(cls, warmup_epochs: int = 5,
+                momentum_correction: bool = True,
+                steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        if not steps_per_epoch:
+            raise ValueError("steps_per_epoch is required for warmup "
+                             "(per-batch fractional-epoch adjustment)")
+
+        def multiplier(epoch):
+            s = size() if runtime.is_initialized() else 1
+            epoch += 1.0 / steps_per_epoch
+            return 1.0 / s * (epoch * (s - 1) / warmup_epochs + 1)
+
+        cb = LearningRateScheduleCallback(
+            multiplier, start_epoch=0, end_epoch=warmup_epochs,
+            staircase=False, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch)
+
+        if verbose:
+            base_epoch_end = cb.on_epoch_end
+
+            def on_epoch_end(epoch, logs=None):
+                base_epoch_end(epoch, logs)
+                if epoch == warmup_epochs - 1 and (
+                        not runtime.is_initialized()
+                        or runtime.world().controller_rank == 0):
+                    print(f"\nEpoch {epoch + 1}: finished gradual learning "
+                          f"rate warmup to {cb._get_lr():g}.")
+
+            cb.on_epoch_end = on_epoch_end
+        return cb

@@ -79,6 +79,53 @@ class TestDistributedOptimizer:
         assert losses[-1] < losses[0], losses
 
 
+class TestLRCallbacks:
+    def _fit(self, callbacks, epochs=3, batches=4):
+        model = _tiny_model()
+        model.compile(optimizer=keras.optimizers.SGD(
+            learning_rate=0.1, momentum=0.9),
+            loss="sparse_categorical_crossentropy")
+        rng = np.random.RandomState(0)
+        x = rng.randn(16 * batches, 4).astype(np.float32)
+        y = rng.randint(0, 3, size=(16 * batches,))
+        h = model.fit(x, y, epochs=epochs, batch_size=16, verbose=0,
+                      callbacks=callbacks)
+        return model, h
+
+    def test_schedule_staircase_multiplier(self):
+        """LR follows initial_lr * multiplier(epoch), logged per epoch
+        (horovod/keras/callbacks.py:90-199 parity)."""
+        cb = hvd_keras.LearningRateScheduleCallback(
+            lambda epoch: 0.1 ** epoch)
+        model, h = self._fit([cb])
+        lrs = h.history["lr"]
+        np.testing.assert_allclose(lrs, [0.1, 0.01, 0.001], rtol=1e-5)
+        # Momentum restored after every batch (correction is transient).
+        assert float(model.optimizer.momentum) == pytest.approx(0.9)
+
+    def test_warmup_reaches_full_lr(self):
+        """Warmup ends at the scaled LR (lr/size -> lr; size=1 single
+        controller => LR stays 0.1 but the ramp formula must hold)."""
+        cb = hvd_keras.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=4)
+        model, h = self._fit([cb], epochs=3)
+        assert h.history["lr"][-1] == pytest.approx(0.1, rel=1e-4)
+
+    def test_warmup_requires_steps_per_epoch(self):
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            hvd_keras.LearningRateWarmupCallback(warmup_epochs=2)
+
+    def test_schedule_window(self):
+        """Outside [start_epoch, end_epoch) the LR is left alone."""
+        cb = hvd_keras.LearningRateScheduleCallback(
+            lambda epoch: 0.5, start_epoch=1, end_epoch=2, staircase=True)
+        _, h = self._fit([cb], epochs=3)
+        lrs = h.history["lr"]
+        assert lrs[0] == pytest.approx(0.1)      # before window
+        assert lrs[1] == pytest.approx(0.05)     # 0.1 * 0.5
+        assert lrs[2] == pytest.approx(0.05)     # untouched after window
+
+
 class TestBroadcastGlobalVariables:
     def test_weights_unchanged_single_controller(self):
         model = _tiny_model()
