@@ -2,14 +2,15 @@
 
 Blockwise causal attention computed entirely in VMEM with an online softmax
 (running max/sum), so the [T, T] score matrix never touches HBM: per grid
-step a [BLOCK_Q, D] query tile is streamed against K/V tiles with MXU
-matmuls (f32 accumulation). Used by the parallel transformer's single-shard
-attention path (``parallel/transformer.py``) when the dense score tensor
-would exhaust HBM; the sequence-parallel path
-(:func:`horovod_tpu.parallel.ring.ring_attention`) keeps its own blockwise
-accumulation across chips.
+step a [BQ, D] query tile is streamed against K/V tiles with MXU matmuls
+(f32 accumulation). Differentiable end to end: a custom VJP recomputes the
+probability tiles from (q, k, lse) inside dq/dkv kernels, so the backward
+pass never materializes scores either. Used by the parallel transformer's
+single-shard attention path (``parallel/transformer.py``); the
+sequence-parallel path (:func:`horovod_tpu.parallel.ring.ring_attention`)
+keeps its own blockwise accumulation across chips.
 
-Off-TPU (CPU tests) the kernel runs in interpreter mode, bit-matching the
+Off-TPU (CPU tests) the kernels run in interpreter mode, bit-matching the
 compiled path's math. `flash_attention` falls back to plain XLA attention
 for shapes the kernel doesn't tile (tiny head_dim or sequences not divisible
 by the block).
@@ -30,18 +31,68 @@ try:  # pallas is part of jax, but guard exotic builds
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-BLOCK_Q = 128
+BLOCK_Q = 128    # minimum tile (tilability floor)
 BLOCK_K = 128
+# Preferred tile sizes (swept on a v5e chip; _pick_block shrinks them to
+# fit short sequences).
+_WANT_BQ = 512
+_WANT_BK = 512
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                 causal: bool, sm_scale: float):
-    """Grid (bh, qi, kb): one [BLOCK_Q, D] × [BLOCK_K, D] tile pair.
+def _pick_block(t: int, want: int) -> int:
+    """Largest power-of-two block <= ``want`` dividing ``t``. Bigger tiles
+    amortize Mosaic's per-grid-step overhead; 128 is the floor the
+    tilability check guarantees."""
+    b = want
+    while b > 128 and t % b:
+        b //= 2
+    return b
 
-    K/V tiles stream through VMEM (small blocks — no whole-sequence
-    residency); the online-softmax state (acc/m/l) persists in scratch
-    across the kb axis, and the normalized output is written at the last
-    kb step. Above-diagonal tile pairs skip all compute under causal.
+
+def _grid_params(semantics):
+    """dimension_semantics lets Mosaic pipeline HBM tile copies against
+    compute across grid steps — without it every step stalls on its loads
+    (measured ~4x on the backward at T=2048)."""
+    return pltpu.CompilerParams(dimension_semantics=semantics)
+
+
+def _causal_run(qi, kb, bq, bk):
+    """A (qi, kb) tile pair contributes under the causal mask iff its
+    lowest k position is <= its highest q position."""
+    return kb * bk <= qi * bq + bq - 1
+
+
+def _tile_mask(s, qi, kb, bq, bk):
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, -1e30)
+
+
+def _scores(q, k, qi, kb, *, causal, sm_scale, bq, bk):
+    """Scaled masked score tile [BQ, BK], shared by forward and both
+    backward kernels so the mask/scale math cannot desynchronize. The
+    matmul stays in the input dtype (bf16 MXU passes with f32
+    accumulation); only diagonal-crossing tiles pay the iota/select mask."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        s = jax.lax.cond(
+            kb * bk + bk > qi * bq,
+            lambda s: _tile_mask(s, qi, kb, bq, bk),
+            lambda s: s, s)
+    return s
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                 l_ref, *, causal: bool, sm_scale: float, bq: int, bk: int):
+    """Grid (bh, qi, kb): one [BQ, D] × [BK, D] tile pair.
+
+    K/V tiles stream through VMEM (no whole-sequence residency); the
+    online-softmax state (acc/m/l) persists in scratch across the kb axis,
+    and the normalized output plus the row log-sum-exp (saved for the
+    backward pass) are written at the last kb step. Above-diagonal tile
+    pairs skip all compute under causal.
     """
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -53,22 +104,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         m_ref[:] = jnp.full_like(m_ref, -1e30)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    run = (kb * BLOCK_K <= qi * BLOCK_Q + BLOCK_Q - 1) if causal else True
+    run = _causal_run(qi, kb, bq, bk) if causal else True
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)                 # [BK, D]
-        v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [BQ, BK]
-        if causal:
-            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, BLOCK_K), 0)
-            k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, BLOCK_K), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
+                    bq=bq, bk=bk)                        # [BQ, BK]
         m_prev = m_ref[:, 0]                             # [BQ]
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)
@@ -77,43 +119,222 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = (l_ref[:, 0] * alpha
                     + jnp.sum(p, axis=-1))[:, None] * jnp.ones_like(l_ref)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new[:, None] * jnp.ones_like(m_ref)
 
     @pl.when(kb == n_kb - 1)
     def _finish():
         l = l_ref[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = jnp.where(l == 0.0, -1e30, m_ref[:, 0] + jnp.log(safe))
+            lse_ref[0] = lse[:, None] * jnp.ones_like(lse_ref[0])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, causal: bool, sm_scale: float, bq: int, bk: int):
+    """Grid (bh, qi, kb): accumulate dq over the kb axis.
+
+    Recomputes the probability tile from (q, k, lse) — the flash-backward
+    trade: [BQ, BK] tiles never leave VMEM.
+    dS = P ∘ (dO·Vᵀ − Δ), dQ = sm_scale · dS·K, Δ = rowsum(dO ∘ O).
+    """
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = _causal_run(qi, kb, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
+                    bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])               # [BQ, BK]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        dq_ref[0] = (acc_ref[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, causal: bool, sm_scale: float,
+                bq: int, bk: int):
+    """Grid (bh, kb, qi): accumulate dk/dv for one K/V tile over all
+    contributing Q tiles. dV = Pᵀ·dO; dK = sm_scale · dSᵀ·Q."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_qi = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _causal_run(qi, kb, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
+                    bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])               # [BQ, BK]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # Pᵀ·dO [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # dSᵀ·Q [BK, D]
+
+    @pl.when(qi == n_qi - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[:] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _row_spec(block_rows, which):
+    """BlockSpec for per-row stats [BH, T, 128]: the stats column is
+    replicated across the 128 lanes so tiles stay MXU/VPU-shaped."""
+    return pl.BlockSpec((1, block_rows, 128), which)
+
+
+def _fwd_pallas(q, k, v, causal: bool, sm_scale: float, interpret: bool,
+                with_lse: bool = True):
+    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, 128] f32 | None).
+
+    ``with_lse=False`` (the no-grad primal) drops the lse output — Mosaic
+    can't dead-code-eliminate an output buffer, and at long T the f32 lse
+    write outweighs the bf16 output itself."""
+    BH, T, D = q.shape
+    bq = _pick_block(T, _WANT_BQ)
+    bk = _pick_block(T, _WANT_BK)
+    grid = (BH, T // bq, T // bk)
+    base = functools.partial(_attn_kernel, causal=causal,
+                             sm_scale=sm_scale, bq=bq, bk=bk)
+    if with_lse:
+        kernel = base
+        out_specs = [
+            pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0)),
+            _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 128), jnp.float32),
+        ]
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+            base(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref)
+        out_specs = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
+        out_shape = jax.ShapeDtypeStruct((BH, T, D), q.dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),            # acc
+            pltpu.VMEM((bq, 128), jnp.float32),          # running max
+            pltpu.VMEM((bq, 128), jnp.float32),          # running sum
+        ],
+        compiler_params=_grid_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return (out if with_lse else (out, None))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal: bool, sm_scale: float, interpret: bool):
+    o, _ = _fwd_pallas(q, k, v, causal, sm_scale, interpret, with_lse=False)
+    return o
+
+
+def _flash_core_fwd(q, k, v, causal, sm_scale, interpret):
+    o, lse = _fwd_pallas(q, k, v, causal, sm_scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(causal, sm_scale, interpret, res, do):
+    q, k, v, o, lse = res
+    BH, T, D = q.shape
+    bq = _pick_block(T, _WANT_BQ)
+    bk = _pick_block(T, _WANT_BK)
+    # Δ_i = Σ_d dO ∘ O — cheap elementwise reduction, XLA fuses it;
+    # replicated across lanes like lse so the kernels read [BQ, 128] tiles.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [BH, T, 1]
+    delta = jnp.broadcast_to(delta, (BH, T, 128))
+    qkv_spec_q = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
+    qkv_spec_k = pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          bq=bq, bk=bk),
+        grid=(BH, T // bq, T // bk),
+        in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
+                  _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0)),
+                  _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0))],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_grid_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv iterate the OTHER way: one K/V tile accumulated over Q tiles.
+    kv_q = pl.BlockSpec((1, bq, D), lambda bh, kb, qi: (bh, qi, 0))
+    kv_k = pl.BlockSpec((1, bk, D), lambda bh, kb, qi: (bh, kb, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          bq=bq, bk=bk),
+        grid=(BH, T // bk, T // bq),
+        in_specs=[kv_q, kv_k, kv_k, kv_q,
+                  _row_spec(bq, lambda bh, kb, qi: (bh, qi, 0)),
+                  _row_spec(bq, lambda bh, kb, qi: (bh, qi, 0))],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, kb, qi: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=_grid_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
                                              "interpret"))
 def _flash_bhtd(q, k, v, causal: bool, sm_scale: float, interpret: bool):
-    """q/k/v: [BH, T, D] -> [BH, T, D]."""
-    BH, T, D = q.shape
-    grid = (BH, T // BLOCK_Q, T // BLOCK_K)
-    kernel = functools.partial(_attn_kernel, causal=causal,
-                               sm_scale=sm_scale)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, D), lambda bh, qi, kb: (bh, qi, 0)),
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, BLOCK_K, D), lambda bh, qi, kb: (bh, kb, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, D),
-                               lambda bh, qi, kb: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((BLOCK_Q, D), jnp.float32),       # acc
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),     # running max
-            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),     # running sum
-        ],
-        interpret=interpret,
-    )(q, k, v)
+    """q/k/v: [BH, T, D] -> [BH, T, D]. Differentiable (custom VJP with
+    Pallas dq/dkv kernels — the score matrix never touches HBM in either
+    direction)."""
+    return _flash_core(q, k, v, causal, sm_scale, interpret)
 
 
 # Above roughly this many bytes of [B, H, T, T] f32 scores, the dense XLA
@@ -124,6 +345,10 @@ def _flash_bhtd(q, k, v, causal: bool, sm_scale: float, interpret: bool):
 # scores > 16 GB HBM) only the kernel runs (232 ms). So "auto" switches
 # for MEMORY, not speed — 4 GiB leaves room for params/activations/
 # optimizer state sharing HBM with the scores in a real training step.
+# NOTE those numbers are inference-only; for TRAINING the dense path also
+# saves the score tensors for backward, so memory binds far earlier than
+# this forward-pass cutover — training code should pass backend="pallas"
+# explicitly (TransformerConfig.attn_backend defaults to it).
 _SCORE_BYTES_CUTOVER = 4 * 1024 ** 3
 
 
@@ -143,8 +368,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
         "xla".
       interpret: force kernel interpreter mode (defaults to True off-TPU).
 
-    The kernel requires T divisible by 128 and D a multiple of 128; other
-    shapes always take the XLA path.
+    Differentiable on every path (the Pallas path via a custom VJP whose
+    dq/dk/dv are themselves Pallas kernels). The kernel requires T
+    divisible by 128 and D a multiple of 128; other shapes always take the
+    XLA path.
     """
     B, T, H, D = q.shape
     if sm_scale is None:

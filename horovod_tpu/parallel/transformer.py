@@ -37,6 +37,18 @@ class TransformerConfig:
     d_ff: int = 512
     n_experts: int = 0          # 0 = dense MLP; >0 = MoE over the ep axis
     dtype: Any = jnp.bfloat16
+    # "pallas" so TRAINING never materializes [T, T] scores for backward
+    # (the flash custom VJP recomputes tiles); untilable shapes still fall
+    # back to XLA dense inside flash_attention.
+    attn_backend: str = "pallas"
+    # Rematerialize each layer in backward, saving only matmul outputs
+    # (dots_saveable): recomputes the cheap elementwise chains, trading
+    # negligible FLOPs for most of the activation memory.
+    remat: bool = False
+    # The tied-head unembed matmul dtype. bf16 keeps the [*, vocab] matmul
+    # on the fast MXU path (f32 accumulation either way); logits and the
+    # softmax stay f32.
+    unembed_dtype: Any = jnp.float32
 
 
 def _axes(mesh: Mesh):
@@ -127,9 +139,7 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
     n_heads_local = cfg.n_heads // (mesh.shape.get("tp", 1))
     d_head = cfg.d_model // cfg.n_heads
 
-    x = params["embed"][tokens].astype(cfg.dtype)     # [B, T, D]
-    aux_total = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
+    def _layer_fwd(layer, x):
         h = _rms_norm(x, layer["ln1"])
         qkv = h @ layer["wqkv"].astype(cfg.dtype)     # [B, T, 3·D/tp]
         B, T, _ = qkv.shape
@@ -138,10 +148,13 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
         if has_sp:
             attn = ring_attention(q, k, v, axis_name="sp", causal=True)
         else:
-            # Single-shard attention: XLA dense for short context, the
-            # Pallas blockwise kernel once scores would blow HBM (auto).
+            # Single-shard attention: the Pallas blockwise kernel by
+            # default (scores never hit HBM in forward OR backward);
+            # untilable shapes fall back to XLA dense inside.
             from ..ops.pallas_attention import flash_attention
-            attn = flash_attention(q, k, v, causal=True).astype(cfg.dtype)
+            attn = flash_attention(q, k, v, causal=True,
+                                   backend=cfg.attn_backend
+                                   ).astype(cfg.dtype)
         attn = attn.reshape(B, T, n_heads_local * d_head)
         proj = attn @ layer["wo"].astype(cfg.dtype)
         if has_tp:
@@ -156,16 +169,31 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
                              layer["w2"][0].astype(cfg.dtype),
                              axis_name="ep")
             x = x + y.reshape(B, T, cfg.d_model)
-            aux_total = aux_total + aux
         else:
+            aux = jnp.zeros((), jnp.float32)
             up = jax.nn.gelu(h @ layer["w1"].astype(cfg.dtype))
             down = up @ layer["w2"].astype(cfg.dtype)
             if has_tp:
                 down = lax.psum(down, "tp")
             x = x + down
+        return x, aux
+
+    if cfg.remat:
+        _layer_fwd = jax.checkpoint(
+            _layer_fwd, policy=jax.checkpoint_policies.dots_saveable)
+
+    x = params["embed"][tokens].astype(cfg.dtype)     # [B, T, D]
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, aux = _layer_fwd(layer, x)
+        aux_total = aux_total + aux
 
     x = _rms_norm(x, params["lnf"])
-    logits = x.astype(jnp.float32) @ params["embed"].T  # tied head, f32
+    # Tied head: bf16 MXU pass with f32 accumulation when unembed_dtype is
+    # bf16; logits are f32 either way for a stable softmax.
+    logits = jnp.matmul(x.astype(cfg.unembed_dtype),
+                        params["embed"].T.astype(cfg.unembed_dtype),
+                        preferred_element_type=jnp.float32)
     return logits, aux_total
 
 
