@@ -1,14 +1,19 @@
-"""Benchmark: ResNet-50 synthetic training throughput (images/sec/chip).
+"""Benchmark: synthetic training throughput (ResNet-50 + transformer LM).
 
 Mirrors the reference's benchmark methodology — `tf_cnn_benchmarks.py
 --variable_update horovod` with synthetic data (``docs/benchmarks.md:8-98``)
 — on the flagship north-star workload (ResNet-50,
-``examples/keras_imagenet_resnet50.py``). The baseline for ``vs_baseline``
-is the reference's only published absolute throughput: ResNet-101 at
-1656.82 images/sec across 16 Pascal GPUs = 103.55 images/sec/GPU
-(``docs/benchmarks.md:24-54``; see /root/repo/BASELINE.md).
+``examples/keras_imagenet_resnet50.py``) plus a transformer-LM training
+step (the TPU-era matmul-dominated workload: bf16, Pallas flash attention,
+``parallel/transformer.py``). The baseline for ``vs_baseline`` is the
+reference's only published absolute throughput: ResNet-101 at 1656.82
+images/sec across 16 Pascal GPUs = 103.55 images/sec/GPU
+(``docs/benchmarks.md:24-54``; see /root/repo/BASELINE.md); other models'
+baselines are FLOPs-scaled from it so the ratio compares hardware.
 
-Default: prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Default: prints TWO JSON lines {"metric", "value", "unit", "vs_baseline"}
+— ResNet-50 images/sec/chip first (the primary metric), then the
+transformer-LM tokens/sec/chip with TFLOP/s and MFU.
 ``--scaling`` (single-controller only): measures throughput at world sizes
 1, 2, 4, ... and the full device count, printing one scaling-efficiency
 JSON line per size (rate_N / (N · rate_1) — the reference's headline
@@ -232,18 +237,159 @@ def measure(devices=None, cfg=None) -> float:
     return batch * cfg["iters"] * k / dt
 
 
+# ---------------------------------------------------------------------------
+# Transformer LM (the second BENCH metric): a matmul-dominated bf16 training
+# step — Pallas flash attention, fused QKV, tied bf16 unembed — sized for one
+# v5e chip. Where ResNet's MFU is bounded by XLA's conv kernels, this is the
+# workload the MXU was built for; the analytic FLOPs model below counts
+# matmul FLOPs only (2 per MAC, backward = 2x forward, causal attention at
+# half), so MFU is not inflated by remat recompute or elementwise work.
+# ---------------------------------------------------------------------------
+
+_LM_TPU = dict(vocab=32768, d_model=2048, n_heads=16, n_layers=8,
+               d_ff=8192, seq=2048, batch_per_chip=8,
+               warmup=2, iters=6, steps_per_call=2)
+_LM_SMOKE = dict(vocab=256, d_model=64, n_heads=2, n_layers=2,
+                 d_ff=256, seq=128, batch_per_chip=4,
+                 warmup=1, iters=2, steps_per_call=1)
+
+
+def lm_train_gflop_per_token(c) -> float:
+    """Matmul-only FLOPs: per layer fwd = 8·d² (qkv+proj) + 4·d·ff (ffn)
+    + 2·T·d (causal QKᵀ+AV, halved) per token; + 2·d·V tied unembed;
+    train = 3× forward."""
+    d, ff, T, V, L = (c["d_model"], c["d_ff"], c["seq"], c["vocab"],
+                      c["n_layers"])
+    fwd = L * (8 * d * d + 4 * d * ff + 2 * T * d) + 2 * d * V
+    return 3 * fwd / 1e9
+
+
+def _lm_config():
+    smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
+    on_tpu = jax.default_backend() == "tpu"
+    return dict(_LM_TPU if on_tpu and not smoke else _LM_SMOKE)
+
+
+def measure_lm(cfg=None) -> float:
+    """Tokens/sec of the compiled transformer-LM train step (one dp axis
+    over all visible devices). Returns total (not per-chip) throughput.
+    Single-controller only: the parallel transformer's mesh covers this
+    process's devices, so an env-world run would train unsynced local
+    replicas and report a meaningless rate."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from horovod_tpu.parallel.transformer import (
+        TransformerConfig, make_parallel_train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = cfg or _lm_config()
+
+    if hvd.is_initialized():
+        hvd.shutdown()
+    hvd.init()
+    if hvd.world().env_world:
+        raise SystemExit(
+            "the transformer_lm benchmark is single-controller only (run "
+            "without tpurun; one process drives all chips)")
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("dp",))
+    n = len(devs)
+    tcfg = TransformerConfig(
+        vocab=cfg["vocab"], d_model=cfg["d_model"], n_heads=cfg["n_heads"],
+        n_layers=cfg["n_layers"], d_ff=cfg["d_ff"], dtype=jnp.bfloat16,
+        attn_backend="pallas" if on_tpu else "xla",
+        unembed_dtype=jnp.bfloat16, remat=bool(cfg.get("remat", False)))
+    opt = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    init_state, step = make_parallel_train_step(tcfg, mesh, opt)
+    params, opt_state = init_state(jax.random.PRNGKey(0))
+
+    B = cfg["batch_per_chip"] * n
+    T = cfg["seq"]
+    rng = np.random.RandomState(0)
+    sharding = NamedSharding(mesh, P("dp", None))
+    tokens = jax.device_put(
+        rng.randint(0, cfg["vocab"], size=(B, T)).astype(np.int32),
+        sharding)
+    labels = jax.device_put(
+        rng.randint(0, cfg["vocab"], size=(B, T)).astype(np.int32),
+        sharding)
+
+    k = int(cfg.get("steps_per_call", 1))
+    if k > 1:
+        import functools
+
+        def _body(carry, _):
+            p2, o2, loss = step(*carry, tokens, labels)
+            return (p2, o2), loss
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def _multi(carry):
+            carry, losses = jax.lax.scan(_body, carry, None, length=k)
+            return carry, losses[-1]
+
+        def run_once(carry):
+            return _multi(carry)
+    else:
+        def run_once(carry):
+            p2, o2, loss = step(*carry, tokens, labels)
+            return (p2, o2), loss
+
+    carry = (params, opt_state)
+    for _ in range(cfg["warmup"]):
+        carry, loss = run_once(carry)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(cfg["iters"]):
+        carry, loss = run_once(carry)
+    final_loss = float(loss)  # host transfer ends the timed region
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+    return B * T * cfg["iters"] * k / dt
+
+
+def lm_line() -> dict:
+    cfg = _lm_config()
+    rate = measure_lm(cfg)
+    per_chip = rate / hvd.size()
+    gflop_tok = lm_train_gflop_per_token(cfg)
+    # Hardware-ratio baseline, like the conv models: the reference GPU's
+    # estimated tokens/sec at this FLOPs cost.
+    baseline = BASELINE_IMG_PER_SEC_PER_DEVICE * (
+        TRAIN_GFLOP_PER_IMAGE["resnet101"] / gflop_tok)
+    line = {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 3),
+        "tflops_per_chip": round(per_chip * gflop_tok / 1e3, 1),
+    }
+    peak = _peak_tflops_per_chip()
+    if peak:
+        line["mfu"] = round(per_chip * gflop_tok / 1e3 / peak, 3)
+    return line
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--scaling", action="store_true",
                    help="measure world sizes 1,2,4,... and report "
                         "scaling efficiency per size")
-    p.add_argument("--model", default="resnet50",
-                   choices=sorted(_TPU_CONFIGS),
-                   help="benchmark model (the reference's "
-                        "tf_cnn_benchmarks family; ignored in smoke/CPU "
-                        "mode)")
+    p.add_argument("--model", default=None,
+                   choices=sorted(_TPU_CONFIGS) + ["transformer_lm"],
+                   help="benchmark model (default: resnet50 then "
+                        "transformer_lm; the conv family mirrors the "
+                        "reference's tf_cnn_benchmarks; ignored in "
+                        "smoke/CPU mode)")
     args = p.parse_args()
-    cfg = _bench_config(args.model)
+    if args.model == "transformer_lm":
+        if args.scaling:
+            raise SystemExit(
+                "--scaling is not supported for transformer_lm (the conv "
+                "family's re-init-with-device-subsets machinery does not "
+                "apply); run it without --scaling")
+        print(json.dumps(lm_line()))
+        return
+    cfg = _bench_config(args.model or "resnet50")
 
     if args.scaling:
         # Scaling mode is single-controller only: it re-inits the world with
@@ -300,7 +446,16 @@ def main() -> None:
     peak = _peak_tflops_per_chip()
     if peak:
         line["mfu"] = round(tflops / peak, 3)
-    print(json.dumps(line))
+    print(json.dumps(line), flush=True)
+
+    if args.model is None:
+        # Second BENCH metric: the transformer-LM step (matmul-dominated —
+        # shows the framework sustains near-peak where the hardware allows).
+        if hvd.world().env_world:
+            print("skipping transformer_lm line: single-controller only",
+                  file=sys.stderr)
+        else:
+            print(json.dumps(lm_line()), flush=True)
 
 
 if __name__ == "__main__":

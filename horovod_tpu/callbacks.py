@@ -33,6 +33,7 @@ import optax
 from . import runtime
 from .ops.collectives import allreduce
 from .optimizer import broadcast_global_variables
+from .utils.lr_schedule import LRScheduleCore, warmup_multiplier
 
 
 def hyper_sgd(learning_rate: float, momentum: float = 0.0,
@@ -119,19 +120,22 @@ class LearningRateScheduleCallback(Callback):
                  end_epoch: Optional[int] = None, staircase: bool = True,
                  momentum_correction: bool = True,
                  steps_per_epoch: Optional[int] = None):
-        self.start_epoch = start_epoch
-        self.end_epoch = end_epoch
-        self.staircase = staircase
-        self.momentum_correction = momentum_correction
-        self.steps_per_epoch = steps_per_epoch
-        self.initial_lr: Optional[float] = None
-        self.restore_momentum: Optional[float] = None
-        self.current_epoch = 0
-        if not callable(multiplier):
-            self.staircase = True
-            self.multiplier = lambda epoch: multiplier
-        else:
-            self.multiplier = multiplier
+        # The schedule/momentum-correction math is shared with the Keras
+        # adapter (utils/lr_schedule.py); this class owns only the optax
+        # hyperparam-state plumbing.
+        self.core = LRScheduleCore(
+            multiplier, start_epoch=start_epoch, end_epoch=end_epoch,
+            staircase=staircase, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch)
+
+    # -- shared-core attribute passthroughs --------------------------------
+    @property
+    def steps_per_epoch(self):
+        return self.core.steps_per_epoch
+
+    @property
+    def end_epoch(self):
+        return self.core.end_epoch
 
     # -- state plumbing ----------------------------------------------------
     def _get_lr(self) -> float:
@@ -141,57 +145,41 @@ class LearningRateScheduleCallback(Callback):
         self.trainer.state.opt_state = set_hyperparam(
             self.trainer.state.opt_state, "learning_rate", v)
 
-    def _has_momentum(self) -> bool:
+    def _get_momentum(self) -> Optional[float]:
         # tree_get returns None (not KeyError) when the key is absent.
-        return optax.tree_utils.tree_get(
-            self.trainer.state.opt_state, "momentum") is not None
+        m = optax.tree_utils.tree_get(self.trainer.state.opt_state,
+                                      "momentum")
+        return None if m is None else float(m)
 
-    # -- schedule ----------------------------------------------------------
-    def _adjust_learning_rate(self, epoch: float):
-        old_lr = self._get_lr()
-        new_lr = self.initial_lr * self.multiplier(epoch)
-        self._set_lr(new_lr)
-        if self.momentum_correction and old_lr > 0 and self._has_momentum():
-            m = get_hyperparam(self.trainer.state.opt_state, "momentum")
-            self.restore_momentum = m
-            self.trainer.state.opt_state = set_hyperparam(
-                self.trainer.state.opt_state, "momentum",
-                m * new_lr / old_lr)
-
-    def _restore_momentum_if_needed(self):
-        if self.restore_momentum is not None:
-            self.trainer.state.opt_state = set_hyperparam(
-                self.trainer.state.opt_state, "momentum",
-                self.restore_momentum)
-            self.restore_momentum = None
+    def _set_momentum(self, v: float):
+        self.trainer.state.opt_state = set_hyperparam(
+            self.trainer.state.opt_state, "momentum", v)
 
     # -- hooks -------------------------------------------------------------
     def on_train_begin(self, logs=None):
-        self.initial_lr = self._get_lr()
-        if not self.staircase and not self.steps_per_epoch:
-            self.steps_per_epoch = getattr(
+        if not self.core.staircase and not self.core.steps_per_epoch:
+            self.core.steps_per_epoch = getattr(
                 self.trainer, "steps_per_epoch", None)
-            if not self.steps_per_epoch:
-                raise ValueError(
-                    "steps_per_epoch is required for staircase=False "
-                    "(smooth per-batch adjustment)")
+        self.core.train_begin(self._get_lr())
 
     def on_epoch_begin(self, epoch, logs=None):
-        self.current_epoch = epoch
+        self.core.epoch_begin(epoch)
 
     def on_batch_begin(self, batch, logs=None):
-        if (self.current_epoch < self.start_epoch
-                or (self.end_epoch is not None
-                    and self.current_epoch >= self.end_epoch)):
+        new_lr = self.core.target_lr(batch)
+        if new_lr is None:
             return
-        if self.staircase and batch == 0:
-            self._adjust_learning_rate(self.current_epoch)
-        elif not self.staircase:
-            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
-            self._adjust_learning_rate(epoch)
+        old_lr = self._get_lr()
+        self._set_lr(new_lr)
+        m = self.core.corrected_momentum(old_lr, new_lr,
+                                         self._get_momentum())
+        if m is not None:
+            self._set_momentum(m)
 
     def on_batch_end(self, batch, logs=None):
-        self._restore_momentum_if_needed()
+        m = self.core.momentum_to_restore()
+        if m is not None:
+            self._set_momentum(m)
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is not None:
@@ -247,17 +235,16 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     def __init__(self, warmup_epochs: int = 5,
                  momentum_correction: bool = True,
                  steps_per_epoch: Optional[int] = None, verbose: int = 0):
-        def multiplier(epoch):
-            size = runtime.size()
-            # Shift so each epoch ends on a round multiplier (reference:
-            # "produce round numbers at the end of each epoch").
-            epoch += 1.0 / self.steps_per_epoch
-            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
         self.verbose = verbose
-        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
-                         staircase=False,
-                         momentum_correction=momentum_correction,
-                         steps_per_epoch=steps_per_epoch)
+        # steps_per_epoch resolves lazily: on_train_begin may fill it in
+        # from the trainer after construction.
+        super().__init__(
+            warmup_multiplier(warmup_epochs,
+                              lambda: self.core.steps_per_epoch,
+                              runtime.size),
+            start_epoch=0, end_epoch=warmup_epochs, staircase=False,
+            momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch)
 
     def on_epoch_end(self, epoch, logs=None):
         super().on_epoch_end(epoch, logs)

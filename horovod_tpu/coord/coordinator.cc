@@ -417,28 +417,97 @@ class Timeline {
 
   // args_json, when non-empty, is a preformatted JSON object attached to the
   // event (the reference's End logs output dtype+shape, timeline.cc:203-220).
+  // Every event carries tid 0: Perfetto and some catapult builds need a tid
+  // to pair B/E durations within a pid.
   void Event(const std::string& name, const char* ph, const char* ev,
              const std::string& args_json = "") {
     if (!f_) return;
     std::lock_guard<std::mutex> l(mu_);
     if (args_json.empty()) {
-      fprintf(f_, "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld},\n",
+      fprintf(f_,
+              "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"tid\":0,"
+              "\"ts\":%lld},\n",
               ev, ph, Pid(name), static_cast<long long>(Now() - start_));
     } else {
       fprintf(f_,
-              "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"ts\":%lld,"
-              "\"args\":%s},\n",
+              "{\"name\":\"%s\",\"ph\":\"%s\",\"pid\":%d,\"tid\":0,"
+              "\"ts\":%lld,\"args\":%s},\n",
               ev, ph, Pid(name), static_cast<long long>(Now() - start_),
               args_json.c_str());
     }
     fflush(f_);
   }
 
+  // Typed transitions enforcing the reference's per-tensor state machine
+  // UNKNOWN→NEGOTIATING→TOP_LEVEL→ACTIVITY (timeline.h:37-42, asserted in
+  // timeline.cc:118-135). A call out of order aborts: an unbalanced B/E
+  // stream corrupts the whole trace, so misuse must fail loudly. All typed
+  // calls happen on the coordinator thread; states_ needs no lock.
+  void NegotiateStart(const std::string& name, const char* op) {
+    Expect(name, State::kUnknown, "NegotiateStart");
+    states_[name] = {State::kNegotiating, 0};
+    Event(name, "B", (std::string("NEGOTIATE_") + op).c_str());
+  }
+  void NegotiateRankReady(const std::string& name, int rank) {
+    Expect(name, State::kNegotiating, "NegotiateRankReady");
+    std::ostringstream ev;
+    ev << "rank_" << rank << "_ready";
+    Event(name, "i", ev.str().c_str());
+  }
+  void NegotiateEnd(const std::string& name, const char* op) {
+    Expect(name, State::kNegotiating, "NegotiateEnd");
+    states_[name] = {State::kUnknown, 0};
+    Event(name, "E", (std::string("NEGOTIATE_") + op).c_str());
+  }
+  void Start(const std::string& name, const char* op) {
+    Expect(name, State::kUnknown, "Start");
+    states_[name] = {State::kTopLevel, 0};
+    Event(name, "B", op);
+  }
+  void ActivityStart(const std::string& name, const char* act) {
+    auto& st = states_[name];
+    if (st.s != State::kTopLevel && st.s != State::kActivity)
+      Violate(name, "ActivityStart");
+    st.s = State::kActivity;
+    st.depth++;
+    Event(name, "B", act);
+  }
+  void ActivityEnd(const std::string& name, const char* act) {
+    auto& st = states_[name];
+    if (st.s != State::kActivity) Violate(name, "ActivityEnd");
+    st.depth--;
+    if (st.depth == 0) st.s = State::kTopLevel;
+    Event(name, "E", act);
+  }
+  void End(const std::string& name, const std::string& args_json = "") {
+    Expect(name, State::kTopLevel, "End");
+    states_.erase(name);
+    Event(name, "E", "", args_json);
+  }
+
  private:
+  enum class State { kUnknown, kNegotiating, kTopLevel, kActivity };
+  struct TState {
+    State s = State::kUnknown;
+    int depth = 0;
+  };
+
+  void Violate(const std::string& name, const char* call) {
+    fprintf(stderr, "[hvdcoord] timeline state violation: %s(%s)\n", call,
+            name.c_str());
+    abort();
+  }
+  void Expect(const std::string& name, State want, const char* call) {
+    auto it = states_.find(name);
+    State s = it == states_.end() ? State::kUnknown : it->second.s;
+    if (s != want) Violate(name, call);
+  }
+
   FILE* f_ = nullptr;
   int64_t start_ = 0;
   std::mutex mu_;
   std::unordered_map<std::string, int> pids_;
+  std::unordered_map<std::string, TState> states_;
 };
 
 // ---------------------------------------------------------------------------
@@ -635,14 +704,11 @@ class Coordinator {
       arrival_order_.push_back(req.name);
       if (timeline_.enabled()) {
         // Phase 1 "NEGOTIATE_<OP>" (timeline.cc:107-140 naming).
-        std::string ev = std::string("NEGOTIATE_") + ReqTypeName(req.type);
-        timeline_.Event(req.name, "B", ev.c_str());
+        timeline_.NegotiateStart(req.name, ReqTypeName(req.type));
       }
     }
     if (timeline_.enabled()) {
-      std::ostringstream ev;
-      ev << "rank_" << req.rank << "_ready";
-      timeline_.Event(req.name, "i", ev.str().c_str());
+      timeline_.NegotiateRankReady(req.name, req.rank);
     }
     if (!p.announced[req.rank]) {
       p.announced[req.rank] = true;
@@ -722,11 +788,9 @@ class Coordinator {
 
     if (timeline_.enabled()) {
       // Close phase 1 with the first-arrived request's op (the name the
-      // NEGOTIATE_* begin event used), open the top-level processing event
-      // (timeline.cc:142-166 Start).
-      std::string neg =
-          std::string("NEGOTIATE_") + ReqTypeName(requests.front().type);
-      timeline_.Event(name, "E", neg.c_str());
+      // NEGOTIATE_* begin event used); the top-level processing event opens
+      // below once validation passes (timeline.cc:142-166 Start).
+      timeline_.NegotiateEnd(name, ReqTypeName(requests.front().type));
     }
 
     // Order requests by rank for deterministic gather concat.
@@ -871,8 +935,8 @@ class Coordinator {
       case ReqType::kReducescatter: act = "REDUCESCATTER"; break;
     }
     if (timeline_.enabled()) {
-      timeline_.Event(resp.name, "B", ReqTypeName(op));  // top-level Start
-      timeline_.Event(resp.name, "B", act);
+      timeline_.Start(resp.name, ReqTypeName(op));  // top-level Start
+      timeline_.ActivityStart(resp.name, act);
     }
     switch (op) {
       case ReqType::kAllreduce: {
@@ -931,7 +995,7 @@ class Coordinator {
         break;
       }
     }
-    if (timeline_.enabled()) timeline_.Event(resp.name, "E", act);
+    if (timeline_.enabled()) timeline_.ActivityEnd(resp.name, act);
     return resp;
   }
 
@@ -945,14 +1009,16 @@ class Coordinator {
 
   void Emit(Response& resp) {
     if (resp.type == RespType::kError) {
-      if (timeline_.enabled()) timeline_.Event(resp.name, "B", "ERROR");
+      // Validation failed before the top-level event opened; the ERROR
+      // send is its own top-level pair.
+      if (timeline_.enabled()) timeline_.Start(resp.name, "ERROR");
       std::string body = EncodeResponse(resp);
       for (int r = 0; r < size_; r++)
         SendFrame(client_fds_[r], send_mu_, body);
-      if (timeline_.enabled()) timeline_.Event(resp.name, "E", "ERROR");
+      if (timeline_.enabled()) timeline_.End(resp.name);
       return;
     }
-    if (timeline_.enabled()) timeline_.Event(resp.name, "B", "RESPOND");
+    if (timeline_.enabled()) timeline_.ActivityStart(resp.name, "RESPOND");
     if (resp.per_rank_payloads.empty()) {
       std::string body = EncodeResponse(resp);
       for (int r = 0; r < size_; r++)
@@ -965,8 +1031,8 @@ class Coordinator {
       }
     }
     if (timeline_.enabled()) {
-      timeline_.Event(resp.name, "E", "RESPOND");
-      timeline_.Event(resp.name, "E", "", TimelineArgs(resp));  // top-level
+      timeline_.ActivityEnd(resp.name, "RESPOND");
+      timeline_.End(resp.name, TimelineArgs(resp));  // top-level
     }
   }
 
@@ -982,14 +1048,14 @@ class Coordinator {
           static_cast<int64_t>(resps[k].payload.size()));
       out.payload += resps[k].payload;
       if (timeline_.enabled())
-        timeline_.Event(resps[k].name, "B", "RESPOND");
+        timeline_.ActivityStart(resps[k].name, "RESPOND");
     }
     std::string body = EncodeResponse(out);
     for (int r = 0; r < size_; r++) SendFrame(client_fds_[r], send_mu_, body);
     if (timeline_.enabled()) {
       for (size_t k = lo; k < hi; k++) {
-        timeline_.Event(resps[k].name, "E", "RESPOND");
-        timeline_.Event(resps[k].name, "E", "", TimelineArgs(resps[k]));
+        timeline_.ActivityEnd(resps[k].name, "RESPOND");
+        timeline_.End(resps[k].name, TimelineArgs(resps[k]));
       }
     }
   }

@@ -138,8 +138,15 @@ class MonitoredTrainingLoop:
             h.begin(self)
         for h in self.hooks:
             h.after_create_session(self)
-        for batch in data:
-            if self._stop:
+        # Check the stop flag BEFORE pulling the next batch: a hook's
+        # request_stop in after_run must not cost the input pipeline one
+        # extra (discarded) batch — with Estimator.train's repeating stream
+        # a trailing `for` check would always over-fetch.
+        it = iter(data)
+        while not self._stop:
+            try:
+                batch = next(it)
+            except StopIteration:
                 break
             step = self.global_step
             for h in self.hooks:

@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import runtime
 from ..ops.collectives import Op
+from ..utils.lr_schedule import LRScheduleCore, warmup_multiplier
 from ..ops.collectives import allgather as _allgather
 from ..ops.collectives import allreduce as _allreduce
 from ..ops.collectives import broadcast as _broadcast
@@ -203,15 +204,18 @@ class LearningRateScheduleCallback:
                 steps_per_epoch: Optional[int] = None):
         import keras
 
-        mult = multiplier if callable(multiplier) \
-            else (lambda epoch: multiplier)
+        # The schedule/momentum-correction math is shared with the core
+        # callback layer (utils/lr_schedule.py); this adapter owns only the
+        # Keras 3 optimizer-variable plumbing.
+        core = LRScheduleCore(
+            multiplier, start_epoch=start_epoch, end_epoch=end_epoch,
+            staircase=staircase, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch)
 
         class _CB(keras.callbacks.Callback):
             def __init__(self):
                 super().__init__()
-                self.initial_lr = None
-                self.current_epoch = 0
-                self.restore_momentum = None
+                self.core = core
 
             # -- optimizer plumbing (Keras 3 variables) -------------------
             def _get_lr(self):
@@ -221,48 +225,35 @@ class LearningRateScheduleCallback:
             def _set_lr(self, v):
                 self.model.optimizer.learning_rate = v
 
-            def _momentum(self):
+            def _get_momentum(self):
                 m = getattr(self.model.optimizer, "momentum", None)
                 return float(m) if m is not None else None
 
             def _set_momentum(self, v):
                 self.model.optimizer.momentum = v
 
-            # -- schedule -------------------------------------------------
-            def _adjust(self, epoch):
-                old_lr = self._get_lr()
-                new_lr = self.initial_lr * mult(epoch)
-                self._set_lr(new_lr)
-                m = self._momentum()
-                if momentum_correction and old_lr > 0 and m:
-                    self.restore_momentum = m
-                    self._set_momentum(m * new_lr / old_lr)
-
+            # -- hooks (decisions delegated to the shared core) -----------
             def on_train_begin(self, logs=None):
-                self.initial_lr = self._get_lr()
-                if not staircase and not steps_per_epoch:
-                    raise ValueError(
-                        "steps_per_epoch is required for staircase=False "
-                        "(smooth per-batch adjustment)")
+                self.core.train_begin(self._get_lr())
 
             def on_epoch_begin(self, epoch, logs=None):
-                self.current_epoch = epoch
+                self.core.epoch_begin(epoch)
 
             def on_train_batch_begin(self, batch, logs=None):
-                if (self.current_epoch < start_epoch
-                        or (end_epoch is not None
-                            and self.current_epoch >= end_epoch)):
+                new_lr = self.core.target_lr(batch)
+                if new_lr is None:
                     return
-                if staircase and batch == 0:
-                    self._adjust(self.current_epoch)
-                elif not staircase:
-                    self._adjust(self.current_epoch
-                                 + float(batch) / steps_per_epoch)
+                old_lr = self._get_lr()
+                self._set_lr(new_lr)
+                m = self.core.corrected_momentum(old_lr, new_lr,
+                                                 self._get_momentum())
+                if m is not None:
+                    self._set_momentum(m)
 
             def on_train_batch_end(self, batch, logs=None):
-                if self.restore_momentum is not None:
-                    self._set_momentum(self.restore_momentum)
-                    self.restore_momentum = None
+                m = self.core.momentum_to_restore()
+                if m is not None:
+                    self._set_momentum(m)
 
             def on_epoch_end(self, epoch, logs=None):
                 if logs is not None:
@@ -283,13 +274,11 @@ class LearningRateWarmupCallback:
             raise ValueError("steps_per_epoch is required for warmup "
                              "(per-batch fractional-epoch adjustment)")
 
-        def multiplier(epoch):
-            s = size() if runtime.is_initialized() else 1
-            epoch += 1.0 / steps_per_epoch
-            return 1.0 / s * (epoch * (s - 1) / warmup_epochs + 1)
-
         cb = LearningRateScheduleCallback(
-            multiplier, start_epoch=0, end_epoch=warmup_epochs,
+            warmup_multiplier(
+                warmup_epochs, lambda: steps_per_epoch,
+                lambda: size() if runtime.is_initialized() else 1),
+            start_epoch=0, end_epoch=warmup_epochs,
             staircase=False, momentum_correction=momentum_correction,
             steps_per_epoch=steps_per_epoch)
 

@@ -220,11 +220,19 @@ def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
         tl.negotiate_instant(name, kind.upper(), ready_ranks=range(w.size))
         tl.start(name, kind.upper())
         tl.activity_start(name, "SCHEDULE")
-    fn = _eager_fn(runtime._generation, kind, per_rank, squeeze, op, root_rank)
-    if tl is not None:
-        tl.activity_end(name)
-        tl.activity_start(name, "XLA_EXECUTE")
-    out = fn(x)
+    try:
+        fn = _eager_fn(runtime._generation, kind, per_rank, squeeze, op,
+                       root_rank)
+        if tl is not None:
+            tl.activity_end(name)
+            tl.activity_start(name, "XLA_EXECUTE")
+        out = fn(x)
+    except BaseException as e:
+        # Close every opened B event so a failed dispatch (invalid op for
+        # the kind, XLA error) cannot leave the trace unbalanced.
+        if tl is not None:
+            tl.abort(name, error=str(e))
+        raise
     if tl is not None:
         tl.activity_end(name)
         tl.end(name, out)
@@ -447,10 +455,11 @@ def broadcast_object(obj=None, root_rank: int = 0,
     """Every process receives the root process's picklable object.
 
     Object collectives operate over PROCESSES (objects are host-side
-    metadata — resume epochs, config dicts, vocabularies); under a single
-    controller there is one host and this is the identity. Non-root ranks
-    may pass anything (ignored). Two rounds: the payload length first
-    (non-roots cannot know it), then the bytes.
+    metadata — resume epochs, config dicts, vocabularies), so ``root_rank``
+    is a PROCESS index; under a single controller there is one host and
+    this is the identity. Non-root ranks may pass anything (ignored). Two
+    rounds: the payload length first (non-roots cannot know it), then the
+    bytes.
     """
     import pickle
 
@@ -460,8 +469,11 @@ def broadcast_object(obj=None, root_rank: int = 0,
     if w.process_count == 1:
         return obj
     base = _auto_name("BroadcastObject", name)
+    # Root test must use process_index, not controller_rank: with >1 device
+    # per process the controller_rank is process_index * local_device_count,
+    # and the coord-plane broadcast below keys roots by process index.
     payload = np.frombuffer(pickle.dumps(obj), np.uint8) \
-        if w.controller_rank == root_rank else np.zeros(0, np.uint8)
+        if w.process_index == root_rank else np.zeros(0, np.uint8)
     n = broadcast(jnp.asarray([payload.size], jnp.int32),
                   root_rank=root_rank, name=base + ".len")
     length = int(np.asarray(n)[0])

@@ -270,7 +270,11 @@ def _flash_core(q, k, v, causal: bool, sm_scale: float, interpret: bool):
 
 def _flash_core_fwd(q, k, v, causal, sm_scale, interpret):
     o, lse = _fwd_pallas(q, k, v, causal, sm_scale, interpret)
-    return o, (q, k, v, o, lse)
+    # Keep only one lane of the lane-replicated [BH, T, 128] lse in the
+    # residuals: the full copy is 128x the statistic and would sit in HBM
+    # from forward to backward of every layer (~134 MB/layer at the bench
+    # config). The backward pass re-broadcasts it like delta.
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_core_bwd(causal, sm_scale, interpret, res, do):
@@ -278,6 +282,7 @@ def _flash_core_bwd(causal, sm_scale, interpret, res, do):
     BH, T, D = q.shape
     bq = _pick_block(T, _WANT_BQ)
     bk = _pick_block(T, _WANT_BK)
+    lse = jnp.broadcast_to(lse, (BH, T, 128))            # re-lane-replicate
     # Δ_i = Σ_d dO ∘ O — cheap elementwise reduction, XLA fuses it;
     # replicated across lanes like lse so the kernels read [BQ, 128] tiles.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),

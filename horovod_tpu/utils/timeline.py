@@ -41,8 +41,22 @@ class _State:
     ACTIVITY = 3
 
 
+class TimelineStateError(RuntimeError):
+    """Illegal timeline transition — a B event would be left unbalanced
+    (the reference asserts these transitions, ``timeline.h:37-42`` enforced
+    in ``timeline.cc:118-135``)."""
+
+
 class Timeline:
-    """Chrome-tracing writer (JSON array format, streaming)."""
+    """Chrome-tracing writer (JSON array format, streaming).
+
+    The per-tensor state machine UNKNOWN→NEGOTIATING→TOP_LEVEL→ACTIVITY is
+    ENFORCED (not just tracked): a call out of order raises
+    :class:`TimelineStateError` instead of silently writing an unbalanced
+    B/E stream. Activities nest; ``_depth`` counts open activity frames.
+    Every duration/instant event carries ``tid: 0`` — Perfetto and some
+    catapult builds require a tid to pair B/E events within a pid.
+    """
 
     FLUSH_INTERVAL_SECS = 1.0  # timeline.h:35
 
@@ -53,6 +67,7 @@ class Timeline:
         self._start = time.monotonic()
         self._pids: dict[str, int] = {}
         self._states: dict[str, int] = {}
+        self._depth: dict[str, int] = {}
         self._last_flush = self._start
         self._closed = False
 
@@ -84,23 +99,38 @@ class Timeline:
                         "args": {"sort_index": pid}})
         return pid
 
+    def _expect(self, tensor_name: str, allowed: tuple, call: str) -> None:
+        state = self._states.get(tensor_name, _State.UNKNOWN)
+        if state not in allowed:
+            names = {0: "UNKNOWN", 1: "NEGOTIATING", 2: "TOP_LEVEL",
+                     3: "ACTIVITY"}
+            raise TimelineStateError(
+                f"timeline: {call}({tensor_name!r}) illegal in state "
+                f"{names[state]} (allowed: "
+                f"{'/'.join(names[s] for s in allowed)})")
+
     # -- negotiation phase (timeline.cc:107-140) ---------------------------
 
     def negotiate_start(self, tensor_name: str, op_kind: str) -> None:
         pid = self._pid(tensor_name)
+        self._expect(tensor_name, (_State.UNKNOWN,), "negotiate_start")
         self._states[tensor_name] = _State.NEGOTIATING
         self._emit({"name": f"NEGOTIATE_{op_kind}", "ph": "B", "pid": pid,
-                    "ts": self._ts_us()})
+                    "tid": 0, "ts": self._ts_us()})
 
     def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
         pid = self._pid(tensor_name)
-        self._emit({"name": str(rank), "ph": "i", "pid": pid,
+        self._expect(tensor_name, (_State.NEGOTIATING,),
+                     "negotiate_rank_ready")
+        self._emit({"name": str(rank), "ph": "i", "pid": pid, "tid": 0,
                     "ts": self._ts_us(), "s": "p"})
 
     def negotiate_end(self, tensor_name: str) -> None:
         pid = self._pid(tensor_name)
+        self._expect(tensor_name, (_State.NEGOTIATING,), "negotiate_end")
         self._states[tensor_name] = _State.UNKNOWN
-        self._emit({"name": "", "ph": "E", "pid": pid, "ts": self._ts_us()})
+        self._emit({"name": "", "ph": "E", "pid": pid, "tid": 0,
+                    "ts": self._ts_us()})
 
     def negotiate_instant(self, tensor_name: str, op_kind: str,
                           ready_ranks: Iterable[int] = ()) -> None:
@@ -115,25 +145,36 @@ class Timeline:
 
     def start(self, tensor_name: str, op_kind: str) -> None:
         pid = self._pid(tensor_name)
+        self._expect(tensor_name, (_State.UNKNOWN,), "start")
         self._states[tensor_name] = _State.TOP_LEVEL
-        self._emit({"name": op_kind, "ph": "B", "pid": pid,
+        self._depth[tensor_name] = 0
+        self._emit({"name": op_kind, "ph": "B", "pid": pid, "tid": 0,
                     "ts": self._ts_us()})
 
     def activity_start(self, tensor_name: str, activity: str) -> None:
         pid = self._pid(tensor_name)
+        self._expect(tensor_name, (_State.TOP_LEVEL, _State.ACTIVITY),
+                     "activity_start")
         self._states[tensor_name] = _State.ACTIVITY
-        self._emit({"name": activity, "ph": "B", "pid": pid,
+        self._depth[tensor_name] = self._depth.get(tensor_name, 0) + 1
+        self._emit({"name": activity, "ph": "B", "pid": pid, "tid": 0,
                     "ts": self._ts_us()})
 
     def activity_end(self, tensor_name: str) -> None:
         pid = self._pid(tensor_name)
-        self._states[tensor_name] = _State.TOP_LEVEL
-        self._emit({"name": "", "ph": "E", "pid": pid, "ts": self._ts_us()})
+        self._expect(tensor_name, (_State.ACTIVITY,), "activity_end")
+        depth = self._depth.get(tensor_name, 1) - 1
+        self._depth[tensor_name] = depth
+        self._states[tensor_name] = (
+            _State.TOP_LEVEL if depth == 0 else _State.ACTIVITY)
+        self._emit({"name": "", "ph": "E", "pid": pid, "tid": 0,
+                    "ts": self._ts_us()})
 
     def end(self, tensor_name: str, output=None) -> None:
         """End the top-level event, logging output dtype+shape
         (timeline.cc:203-220)."""
         pid = self._pid(tensor_name)
+        self._expect(tensor_name, (_State.TOP_LEVEL,), "end")
         args = {}
         if output is not None:
             shape = getattr(output, "shape", None)
@@ -143,9 +184,30 @@ class Timeline:
             if dtype is not None:
                 args["dtype"] = str(dtype)
         self._states[tensor_name] = _State.UNKNOWN
-        ev = {"name": "", "ph": "E", "pid": pid, "ts": self._ts_us()}
+        ev = {"name": "", "ph": "E", "pid": pid, "tid": 0,
+              "ts": self._ts_us()}
         if args:
             ev["args"] = args
+        self._emit(ev)
+
+    def abort(self, tensor_name: str, error: Optional[str] = None) -> None:
+        """Close every open B event for ``tensor_name`` after a dispatch
+        failure so the trace stays balanced (error paths must not corrupt
+        the stream). Safe to call in any state."""
+        state = self._states.get(tensor_name, _State.UNKNOWN)
+        if state == _State.UNKNOWN:
+            return
+        pid = self._pid(tensor_name)
+        if state == _State.NEGOTIATING:
+            self.negotiate_end(tensor_name)
+            return
+        while self._depth.get(tensor_name, 0) > 0:
+            self.activity_end(tensor_name)
+        ev = {"name": "", "ph": "E", "pid": pid, "tid": 0,
+              "ts": self._ts_us()}
+        if error:
+            ev["args"] = {"error": error}
+        self._states[tensor_name] = _State.UNKNOWN
         self._emit(ev)
 
     def close(self) -> None:

@@ -117,6 +117,94 @@ class TestTimeline:
         assert any(a.get("dtype") == "float32" and a.get("shape") == [4]
                    for a in end_args), end_args
 
+    def test_state_machine_enforced(self, tmp_path):
+        """Illegal transitions raise instead of writing an unbalanced B/E
+        stream (reference asserts these, timeline.h:37-42 enforced in
+        timeline.cc:118-135); every event carries tid 0 (Perfetto needs a
+        tid to pair durations within a pid)."""
+        from horovod_tpu.utils.timeline import Timeline, TimelineStateError
+        path = str(tmp_path / "sm.json")
+        tl = Timeline(path)
+        with pytest.raises(TimelineStateError):
+            tl.end("x")
+        with pytest.raises(TimelineStateError):
+            tl.activity_start("x", "A")
+        tl.start("x", "OP")
+        with pytest.raises(TimelineStateError):
+            tl.start("x", "OP")  # B-without-E
+        tl.activity_start("x", "A")
+        tl.activity_start("x", "A2")  # nesting is legal
+        with pytest.raises(TimelineStateError):
+            tl.end("x")  # activities still open
+        tl.activity_end("x")
+        tl.activity_end("x")
+        with pytest.raises(TimelineStateError):
+            tl.activity_end("x")  # E-without-B
+        tl.end("x")
+        with pytest.raises(TimelineStateError):
+            tl.negotiate_rank_ready("x", 0)  # not negotiating
+        tl.negotiate_start("x", "ALLREDUCE")
+        with pytest.raises(TimelineStateError):
+            tl.start("x", "OP")  # negotiation still open
+        tl.negotiate_end("x")
+        tl.close()
+        events = json.load(open(path))
+        assert all("tid" in e for e in events
+                   if e.get("ph") in ("B", "E", "i")), events
+        depth = 0
+        for e in events:
+            if e.get("ph") == "B":
+                depth += 1
+            elif e.get("ph") == "E":
+                depth -= 1
+                assert depth >= 0
+        assert depth == 0
+
+    def test_abort_balances_trace_on_failed_dispatch(self, tmp_path):
+        """A dispatch that raises mid-flight (invalid op for the kind) must
+        close every opened B event — error paths may not corrupt the
+        single-controller trace (round-2 advisory)."""
+        tl = str(tmp_path / "abort.json")
+        script = textwrap.dedent(f"""
+            import os, sys
+            os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            os.environ["HOROVOD_TIMELINE"] = {tl!r}
+            sys.path.insert(0, {ROOT!r})
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+            from horovod_tpu.training import shard_batch
+            hvd.init()
+            x = shard_batch(jnp.arange(16.0))
+            try:
+                hvd.reducescatter(x, op=hvd.Op.MIN, name="bad")  # raises
+            except ValueError:
+                pass
+            else:
+                raise AssertionError("expected ValueError")
+            hvd.allreduce(jnp.ones(3), name="good")
+            hvd.shutdown()
+        """)
+        r = subprocess.run([sys.executable, "-c", script],
+                           env=dict(os.environ, PYTHONPATH="",
+                                    JAX_PLATFORMS="cpu"),
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stdout + r.stderr
+        events = json.load(open(tl))
+        depth = {}
+        for e in events:
+            if e.get("ph") == "B":
+                depth[e["pid"]] = depth.get(e["pid"], 0) + 1
+            elif e.get("ph") == "E":
+                depth[e["pid"]] = depth.get(e["pid"], 0) - 1
+                assert depth[e["pid"]] >= 0, events
+        assert all(d == 0 for d in depth.values()), depth
+        # Both the failed and the successful collective appear.
+        blob = json.dumps(events)
+        assert "HorovodReducescatter_bad" in blob
+        assert "HorovodAllreduce_good" in blob
+
     def test_single_controller_timeline(self, tmp_path):
         """HOROVOD_TIMELINE single-controller: the Python writer records
         eager collectives."""
