@@ -20,39 +20,18 @@ if _ROOT not in sys.path:
 
 import numpy as np
 
-
-def _synthetic(n, shape, classes, seed):
-    rng = np.random.RandomState(seed)
-    # A learnable task: labels depend linearly on the input so loss
-    # actually decreases (pure noise would plateau instantly).
-    x = rng.randn(n, *shape).astype(np.float32)
-    w = rng.randn(int(np.prod(shape)), classes).astype(np.float32)
-    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype(np.int32)
-    return x, y
+from horovod_tpu.data import load_dataset  # framework-level loader
 
 
 def load_mnist(n_train=4096, n_test=512):
-    d = os.environ.get("HVD_DATA_DIR")
-    if d and os.path.exists(os.path.join(d, "mnist.npz")):
-        with np.load(os.path.join(d, "mnist.npz")) as f:
-            return ((f["x_train"].reshape(-1, 784).astype(np.float32) / 255.0,
-                     f["y_train"].astype(np.int32)),
-                    (f["x_test"].reshape(-1, 784).astype(np.float32) / 255.0,
-                     f["y_test"].astype(np.int32)))
-    return (_synthetic(n_train, (784,), 10, 0),
-            _synthetic(n_test, (784,), 10, 1))
+    train, test, _ = load_dataset("mnist", n_train=n_train, n_test=n_test)
+    return train, test
 
 
 def load_cifar10(n_train=4096, n_test=512):
-    d = os.environ.get("HVD_DATA_DIR")
-    if d and os.path.exists(os.path.join(d, "cifar10.npz")):
-        with np.load(os.path.join(d, "cifar10.npz")) as f:
-            return ((f["x_train"].astype(np.float32) / 255.0,
-                     f["y_train"].astype(np.int32).ravel()),
-                    (f["x_test"].astype(np.float32) / 255.0,
-                     f["y_test"].astype(np.int32).ravel()))
-    return (_synthetic(n_train, (32, 32, 3), 10, 0),
-            _synthetic(n_test, (32, 32, 3), 10, 1))
+    train, test, _ = load_dataset("cifar10", n_train=n_train,
+                                  n_test=n_test)
+    return train, test
 
 
 def batches(x, y, global_batch, *, seed=0, shuffle=True):

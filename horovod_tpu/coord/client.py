@@ -24,7 +24,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..exceptions import FailedPreconditionError, TransportError
+from ..exceptions import (FailedPreconditionError, StalledError,
+                          TransportError)
 from ..utils import config as _config
 
 _REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
@@ -81,6 +82,10 @@ def _build_and_load() -> ctypes.CDLL:
     lib.hvdcoord_responses_received.argtypes = []
     lib.hvdcoord_ops_completed.restype = ctypes.c_longlong
     lib.hvdcoord_ops_completed.argtypes = []
+    lib.hvdcoord_ring_ops.restype = ctypes.c_longlong
+    lib.hvdcoord_ring_ops.argtypes = []
+    lib.hvdcoord_ring_bytes_sent.restype = ctypes.c_longlong
+    lib.hvdcoord_ring_bytes_sent.argtypes = []
     return lib
 
 
@@ -196,6 +201,10 @@ class CoordClient:
             self._inflight.discard(handle.name)
         if rc == 1:
             raise FailedPreconditionError(err.value.decode())
+        if rc == 3:
+            # HOROVOD_STALL_TIMEOUT strict mode (the reference only warns,
+            # mpi_ops.cc:1153-1196; the hard deadline is a TPU-era extra).
+            raise StalledError(err.value.decode())
         if rc != 0:
             raise TransportError(err.value.decode())
 
@@ -234,6 +243,15 @@ class CoordClient:
 
     def ops_completed(self) -> int:
         return int(self._lib.hvdcoord_ops_completed())
+
+    # -- ring-plane observability (large allreduces ride a client-to-client
+    # chunked ring, 2·(N-1)/N bytes/rank — the byte-accounting test's
+    # evidence; threshold: HOROVOD_RING_THRESHOLD) ------------------------
+    def ring_ops(self) -> int:
+        return int(self._lib.hvdcoord_ring_ops())
+
+    def ring_bytes_sent(self) -> int:
+        return int(self._lib.hvdcoord_ring_bytes_sent())
 
     def shutdown(self):
         self._lib.hvdcoord_shutdown()

@@ -38,6 +38,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -58,6 +59,15 @@ enum class ReqType : uint8_t {
   // reducescatter; not in reference v0.11.2).
   kAlltoall = 3,
   kReducescatter = 4,
+  // Large-payload allreduce announced WITHOUT its payload: the data plane
+  // is a client-to-client chunked ring (reduce-scatter + allgather), the
+  // bandwidth-optimal algorithm the reference gets from MPI_Allreduce
+  // (mpi_ops.cc:1061-1064 — every real MPI rings large messages). The
+  // coordinator only negotiates/validates and ships the ring plan; payload
+  // bytes never transit rank 0, so per-rank traffic is 2·(N-1)/N · bytes
+  // independent of world size (vs the star's N·bytes coordinator
+  // ingress/egress).
+  kAllreduceRing = 5,
 };
 enum class RespType : uint8_t {
   kAllreduce = 0,
@@ -67,6 +77,7 @@ enum class RespType : uint8_t {
   kShutdown = 4,
   kAlltoall = 5,
   kReducescatter = 6,
+  kAllreduceRing = 7,  // carries the ring plan (peer addresses), no payload
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -113,8 +124,22 @@ const char* ReqTypeName(ReqType t) {
     case ReqType::kBroadcast: return "BROADCAST";
     case ReqType::kAlltoall: return "ALLTOALL";
     case ReqType::kReducescatter: return "REDUCESCATTER";
+    // Distinct name so a mixed star/ring announcement (skewed
+    // HOROVOD_RING_THRESHOLD across ranks) produces a self-explaining
+    // mismatch error.
+    case ReqType::kAllreduceRing: return "ALLREDUCE_RING";
   }
   return "UNKNOWN";
+}
+
+int DTypeSize(DType t) {
+  switch (t) {
+    case DType::kU8: case DType::kI8: case DType::kBool: return 1;
+    case DType::kU16: case DType::kI16: case DType::kBF16: return 2;
+    case DType::kI32: case DType::kF32: return 4;
+    case DType::kI64: case DType::kF64: return 8;
+  }
+  return 1;
 }
 
 // ---------------------------------------------------------------------------
@@ -133,7 +158,7 @@ enum class MsgTag : uint8_t {
 // different builds — exactly the cross-rank config skew init must reject
 // (the analog of the reference's per-tensor placement validation,
 // mpi_ops.cc:439-449, moved to init time where TPU worlds can check it).
-constexpr int32_t kProtocolVersion = 2;
+constexpr int32_t kProtocolVersion = 3;
 
 struct Request {
   int32_t rank = -1;
@@ -158,6 +183,9 @@ struct Response {
   // frame; the client splits by per-name byte counts.
   std::vector<std::string> fused_names;
   std::vector<int64_t> fused_nbytes;
+  // Ring plan (kAllreduceRing): "ip:port" peer data-plane addresses indexed
+  // by rank; clients run the chunked ring among themselves.
+  std::vector<std::string> ring_peers;
   // Coordinator-local bookkeeping (never on the wire).
   DType dtype = DType::kF32;
   std::vector<int64_t> shape;                 // output shape (timeline args)
@@ -242,6 +270,8 @@ std::string EncodeResponse(const Response& r) {
     b.PutStr(r.fused_names[i]);
     b.PutI64(r.fused_nbytes[i]);
   }
+  b.PutI32(static_cast<int32_t>(r.ring_peers.size()));
+  for (const auto& p : r.ring_peers) b.PutStr(p);
   b.PutStr(r.payload);
   return b.str();
 }
@@ -258,6 +288,8 @@ Response DecodeResponse(Reader& rd) {
     r.fused_names.push_back(rd.GetStr());
     r.fused_nbytes.push_back(rd.GetI64());
   }
+  int np = rd.GetI32();
+  for (int i = 0; i < np; i++) r.ring_peers.push_back(rd.GetStr());
   r.payload = rd.GetStr();
   return r;
 }
@@ -307,10 +339,10 @@ bool RecvFrame(int fd, std::string* body) {
 // ---------------------------------------------------------------------------
 
 template <typename T>
-void ReduceInto(RedOp op, std::string* acc, const std::string& in) {
-  T* a = reinterpret_cast<T*>(&(*acc)[0]);
-  const T* b = reinterpret_cast<const T*>(in.data());
-  size_t n = in.size() / sizeof(T);
+void ReduceIntoRaw(RedOp op, char* acc, const char* in, size_t nbytes) {
+  T* a = reinterpret_cast<T*>(acc);
+  const T* b = reinterpret_cast<const T*>(in);
+  size_t n = nbytes / sizeof(T);
   switch (op) {
     case RedOp::kSum:
       for (size_t i = 0; i < n; i++) a[i] += b[i];
@@ -328,10 +360,10 @@ void ReduceInto(RedOp op, std::string* acc, const std::string& in) {
 }
 
 // bfloat16: widen to float, reduce, narrow (round-to-nearest-even).
-void ReduceIntoBF16(RedOp op, std::string* acc, const std::string& in) {
-  uint16_t* a = reinterpret_cast<uint16_t*>(&(*acc)[0]);
-  const uint16_t* b = reinterpret_cast<const uint16_t*>(in.data());
-  size_t n = in.size() / 2;
+void ReduceIntoBF16(RedOp op, char* accp, const char* inp, size_t nbytes) {
+  uint16_t* a = reinterpret_cast<uint16_t*>(accp);
+  const uint16_t* b = reinterpret_cast<const uint16_t*>(inp);
+  size_t n = nbytes / 2;
   for (size_t i = 0; i < n; i++) {
     uint32_t av = static_cast<uint32_t>(a[i]) << 16;
     uint32_t bv = static_cast<uint32_t>(b[i]) << 16;
@@ -352,28 +384,33 @@ void ReduceIntoBF16(RedOp op, std::string* acc, const std::string& in) {
   }
 }
 
-void ReducePayload(DType t, RedOp op, std::string* acc, const std::string& in) {
+void ReducePayloadRaw(DType t, RedOp op, char* acc, const char* in,
+                      size_t nbytes) {
   switch (t) {
-    case DType::kU8: return ReduceInto<uint8_t>(op, acc, in);
-    case DType::kI8: return ReduceInto<int8_t>(op, acc, in);
-    case DType::kU16: return ReduceInto<uint16_t>(op, acc, in);
-    case DType::kI16: return ReduceInto<int16_t>(op, acc, in);
-    case DType::kI32: return ReduceInto<int32_t>(op, acc, in);
-    case DType::kI64: return ReduceInto<int64_t>(op, acc, in);
-    case DType::kF32: return ReduceInto<float>(op, acc, in);
-    case DType::kF64: return ReduceInto<double>(op, acc, in);
+    case DType::kU8: return ReduceIntoRaw<uint8_t>(op, acc, in, nbytes);
+    case DType::kI8: return ReduceIntoRaw<int8_t>(op, acc, in, nbytes);
+    case DType::kU16: return ReduceIntoRaw<uint16_t>(op, acc, in, nbytes);
+    case DType::kI16: return ReduceIntoRaw<int16_t>(op, acc, in, nbytes);
+    case DType::kI32: return ReduceIntoRaw<int32_t>(op, acc, in, nbytes);
+    case DType::kI64: return ReduceIntoRaw<int64_t>(op, acc, in, nbytes);
+    case DType::kF32: return ReduceIntoRaw<float>(op, acc, in, nbytes);
+    case DType::kF64: return ReduceIntoRaw<double>(op, acc, in, nbytes);
     case DType::kBool: {
       // bool: SUM/MAX = logical OR, MIN/PROD = logical AND (the lattice
       // forms the reference's MPI byte-sum reduces to for 0/1 values).
-      uint8_t* a = reinterpret_cast<uint8_t*>(&(*acc)[0]);
-      const uint8_t* b = reinterpret_cast<const uint8_t*>(in.data());
+      uint8_t* a = reinterpret_cast<uint8_t*>(acc);
+      const uint8_t* b = reinterpret_cast<const uint8_t*>(in);
       bool is_or = (op == RedOp::kSum || op == RedOp::kMax);
-      for (size_t i = 0; i < in.size(); i++)
+      for (size_t i = 0; i < nbytes; i++)
         a[i] = is_or ? (a[i] || b[i]) : (a[i] && b[i]);
       return;
     }
-    case DType::kBF16: return ReduceIntoBF16(op, acc, in);
+    case DType::kBF16: return ReduceIntoBF16(op, acc, in, nbytes);
   }
+}
+
+void ReducePayload(DType t, RedOp op, std::string* acc, const std::string& in) {
+  ReducePayloadRaw(t, op, &(*acc)[0], in.data(), in.size());
 }
 
 // ---------------------------------------------------------------------------
@@ -587,13 +624,15 @@ class Coordinator {
       std::string hello;
       std::string reject;
       int32_t rank = -1;
-      if (!RecvFrame(fd, &hello) || hello.size() != 12) {
+      int32_t peer_port = 0;
+      if (!RecvFrame(fd, &hello) || hello.size() != 16) {
         reject = "malformed hello frame (client/coordinator build mismatch?)";
       } else {
         int32_t csize, cver;
         memcpy(&rank, hello.data(), 4);
         memcpy(&csize, hello.data() + 4, 4);
         memcpy(&cver, hello.data() + 8, 4);
+        memcpy(&peer_port, hello.data() + 12, 4);
         std::ostringstream o;
         if (cver != kProtocolVersion) {
           o << "protocol version mismatch: coordinator speaks v"
@@ -629,6 +668,19 @@ class Coordinator {
       setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
                  sizeof(no_timeout));
       client_fds_[rank] = fd;
+      // Record the rank's ring data-plane address: the IP this connection
+      // came from + the peer-listen port announced in the hello.
+      {
+        sockaddr_in peer{};
+        socklen_t plen = sizeof(peer);
+        char ip[INET_ADDRSTRLEN] = "127.0.0.1";
+        if (getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) == 0)
+          inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+        std::ostringstream a;
+        a << ip << ":" << peer_port;
+        if (peer_addrs_.empty()) peer_addrs_.assign(size_, std::string());
+        peer_addrs_[rank] = a.str();
+      }
       accepted++;
     }
 
@@ -820,7 +872,8 @@ class Coordinator {
       }
     }
 
-    if (op == ReqType::kAllreduce || op == ReqType::kReducescatter) {
+    if (op == ReqType::kAllreduce || op == ReqType::kReducescatter ||
+        op == ReqType::kAllreduceRing) {
       RedOp rop = requests[0].red_op;
       for (auto& r : requests) {
         if (r.red_op != rop) {
@@ -835,7 +888,8 @@ class Coordinator {
     }
 
     if (op == ReqType::kAllreduce || op == ReqType::kBroadcast ||
-        op == ReqType::kAlltoall || op == ReqType::kReducescatter) {
+        op == ReqType::kAlltoall || op == ReqType::kReducescatter ||
+        op == ReqType::kAllreduceRing) {
       const auto& shape = requests[0].shape;
       for (auto& r : requests) {
         if (r.shape != shape) {
@@ -933,6 +987,7 @@ class Coordinator {
       case ReqType::kBroadcast: act = "BCAST"; break;
       case ReqType::kAlltoall: act = "ALLTOALL"; break;
       case ReqType::kReducescatter: act = "REDUCESCATTER"; break;
+      case ReqType::kAllreduceRing: act = "RING_PLAN"; break;
     }
     if (timeline_.enabled()) {
       timeline_.Start(resp.name, ReqTypeName(op));  // top-level Start
@@ -977,6 +1032,14 @@ class Coordinator {
             resp.per_rank_payloads[r] +=
                 requests[s].payload.substr(r * block, block);
         }
+        break;
+      }
+      case ReqType::kAllreduceRing: {
+        // No host execution: ship the ring plan; clients move the data
+        // among themselves (reduce-scatter + allgather over the rank ring).
+        resp.type = RespType::kAllreduceRing;
+        resp.shape = requests[0].shape;
+        resp.ring_peers = peer_addrs_;
         break;
       }
       case ReqType::kReducescatter: {
@@ -1127,6 +1190,7 @@ class Coordinator {
   Timeline timeline_;
 
   std::unordered_map<std::string, PendingTensor> table_;  // MessageTable
+  std::vector<std::string> peer_addrs_;  // rank -> "ip:port" ring data plane
   std::vector<std::string> arrival_order_;
   std::chrono::steady_clock::time_point last_stall_check_ =
       std::chrono::steady_clock::now();
@@ -1140,6 +1204,45 @@ class Client {
  public:
   Client(int rank, int size, const std::string& host, int port)
       : rank_(rank), size_(size) {
+    // Ring data-plane threshold (bytes): allreduces at or above it skip the
+    // star and ring client-to-client. 0 disables. Must agree across ranks
+    // (skew produces a self-explaining ALLREDUCE vs ALLREDUCE_RING
+    // mismatch error at negotiation).
+    ring_threshold_ = 4 << 20;
+    if (const char* t = getenv("HOROVOD_RING_THRESHOLD")) {
+      ring_threshold_ = atoll(t);
+      if (ring_threshold_ < 0) ring_threshold_ = 0;
+    }
+    // Strict stall mode: Wait() fails with a StalledError after this many
+    // seconds (0 = off; the reference only warns, mpi_ops.cc:1153-1196).
+    if (const char* t = getenv("HOROVOD_STALL_TIMEOUT")) {
+      stall_timeout_secs_ = atof(t);
+      if (stall_timeout_secs_ < 0) stall_timeout_secs_ = 0;
+    }
+    // Peer-listen socket for the ring data plane (ephemeral port, announced
+    // in the hello; the left ring neighbor connects here).
+    peer_listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (peer_listen_fd_ >= 0) {
+      int pone = 1;
+      setsockopt(peer_listen_fd_, SOL_SOCKET, SO_REUSEADDR, &pone,
+                 sizeof(pone));
+      sockaddr_in paddr{};
+      paddr.sin_family = AF_INET;
+      paddr.sin_addr.s_addr = htonl(INADDR_ANY);
+      paddr.sin_port = 0;
+      if (bind(peer_listen_fd_, reinterpret_cast<sockaddr*>(&paddr),
+               sizeof(paddr)) == 0 &&
+          listen(peer_listen_fd_, 1) == 0) {
+        socklen_t alen = sizeof(paddr);
+        if (getsockname(peer_listen_fd_,
+                        reinterpret_cast<sockaddr*>(&paddr), &alen) == 0)
+          peer_port_ = ntohs(paddr.sin_port);
+      }
+      if (peer_port_ == 0) {
+        ::close(peer_listen_fd_);
+        peer_listen_fd_ = -1;
+      }
+    }
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
@@ -1159,10 +1262,12 @@ class Client {
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     int32_t ver = kProtocolVersion;
+    int32_t pport = peer_port_;
     std::string hello;
     hello.append(reinterpret_cast<char*>(&rank_), 4);
     hello.append(reinterpret_cast<char*>(&size_), 4);
     hello.append(reinterpret_cast<char*>(&ver), 4);
+    hello.append(reinterpret_cast<char*>(&pport), 4);
     SendFrame(fd_, send_mu_, hello);
     // Synchronous ack: the coordinator validates {rank, size, version}
     // before admitting us — misconfigured worlds fail HERE with a message,
@@ -1215,6 +1320,9 @@ class Client {
       ::close(fd_);
       fd_ = -1;
     }
+    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
+    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
+    if (peer_listen_fd_ >= 0) { ::close(peer_listen_fd_); peer_listen_fd_ = -1; }
   }
 
   bool Enqueue(const Request& req) {
@@ -1222,19 +1330,211 @@ class Client {
     return SendFrame(fd_, send_mu_, EncodeRequest(req));
   }
 
-  // Blocks until the named op completes; returns the response.
-  bool Wait(const std::string& name, Response* out) {
+  // Enqueue with ring election: a large allreduce is announced WITHOUT its
+  // payload (kAllreduceRing); the bytes stay here until the coordinator's
+  // ring plan arrives, then move client-to-client. Everything else takes
+  // the star.
+  bool Submit(Request req) {
+    if (req.type == ReqType::kAllreduce && size_ > 1 &&
+        ring_threshold_ > 0 && peer_listen_fd_ >= 0 &&
+        static_cast<int64_t>(req.payload.size()) >= ring_threshold_) {
+      {
+        std::lock_guard<std::mutex> l(ring_mu_);
+        ring_pending_[req.name] =
+            RingWork{std::move(req.payload), req.dtype, req.red_op};
+      }
+      req.type = ReqType::kAllreduceRing;
+      req.payload.clear();
+      if (!Enqueue(req)) {
+        std::lock_guard<std::mutex> l(ring_mu_);
+        ring_pending_.erase(req.name);
+        return false;
+      }
+      return true;
+    }
+    return Enqueue(req);
+  }
+
+  // Blocks until the named op completes. Returns 0 ok, 1 connection lost,
+  // 2 stall deadline exceeded (HOROVOD_STALL_TIMEOUT strict mode; 0=off —
+  // then this blocks forever like the reference, which only warns).
+  int Wait(const std::string& name, Response* out) {
     std::unique_lock<std::mutex> l(mu_);
-    cv_.wait(l, [&] {
-      return completed_.count(name) > 0 || dead_;
-    });
-    if (completed_.count(name) == 0) return false;
+    auto ready = [&] { return completed_.count(name) > 0 || dead_; };
+    if (stall_timeout_secs_ > 0) {
+      if (!cv_.wait_for(
+              l, std::chrono::duration<double>(stall_timeout_secs_),
+              ready)) {
+        // Abandon the op: names are auto-generated and never waited
+        // again, so a late-arriving response must be dropped on receipt
+        // or it would sit in completed_ forever (the documented
+        // continue-after-StalledError usage would leak every payload).
+        abandoned_.insert(name);
+        return 2;
+      }
+    } else {
+      cv_.wait(l, ready);
+    }
+    if (completed_.count(name) == 0) return 1;
     *out = std::move(completed_[name]);
     completed_.erase(name);
-    return true;
+    return 0;
   }
 
  private:
+  // -- ring data plane -----------------------------------------------------
+  // Chunked ring allreduce (reduce-scatter + allgather) among the clients,
+  // the bandwidth-optimal exchange the reference gets from MPI_Allreduce's
+  // internals: each rank sends 2·(N-1)/N · bytes regardless of world size.
+  // Runs on the recv thread, in coordinator response order — every rank
+  // executes ring ops in the same sequence, so rings cannot interleave or
+  // deadlock across ops (the reference's PerformOperation ordering).
+
+  struct RingWork {
+    std::string payload;
+    DType dtype;
+    RedOp red_op;
+  };
+
+  bool EnsurePeers(const std::vector<std::string>& peers) {
+    if (peer_out_fd_ >= 0 && peer_in_fd_ >= 0) return true;
+    int right = (rank_ + 1) % size_;
+    int left = (rank_ - 1 + size_) % size_;
+    // Connect to the right neighbor on a helper thread while accepting the
+    // left neighbor here — both directions establish concurrently.
+    std::atomic<int> out_fd{-1};
+    std::thread connector([&] {
+      const std::string& addr = peers[right];
+      size_t c = addr.rfind(':');
+      std::string ip = addr.substr(0, c);
+      int pport = atoi(addr.c_str() + c + 1);
+      for (int attempt = 0; attempt < 600; attempt++) {
+        int s = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in a{};
+        a.sin_family = AF_INET;
+        a.sin_port = htons(static_cast<uint16_t>(pport));
+        inet_pton(AF_INET, ip.c_str(), &a.sin_addr);
+        if (::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0) {
+          int one = 1;
+          setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          int32_t me = rank_;
+          if (::send(s, &me, 4, MSG_NOSIGNAL) == 4) {
+            out_fd.store(s);
+            return;
+          }
+          ::close(s);
+          return;
+        }
+        ::close(s);
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      }
+    });
+    // Accept the left neighbor (30 s bound; a ring plan means every rank
+    // got the same response, so the neighbor is coming). Stray connections
+    // to the data port (port scanners, probes) must not hang or kill the
+    // rank — same hardening standard as the control-plane hello: bound the
+    // identity read with a recv timeout, and keep accepting until the real
+    // neighbor shows up or the deadline passes.
+    int in_fd = -1;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(30);
+    while (in_fd < 0) {
+      auto left_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count();
+      if (left_ms <= 0) break;
+      pollfd pfd{peer_listen_fd_, POLLIN, 0};
+      if (::poll(&pfd, 1, static_cast<int>(left_ms)) <= 0) break;
+      int fd = ::accept(peer_listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      timeval id_timeout{/*tv_sec=*/5, /*tv_usec=*/0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &id_timeout,
+                 sizeof(id_timeout));
+      int32_t who = -1;
+      if (RecvAll(fd, &who, 4) && who == left) {
+        timeval no_timeout{0, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
+                   sizeof(no_timeout));
+        in_fd = fd;
+      } else {
+        fprintf(stderr,
+                "hvdcoord: rejecting stray connection on ring data port "
+                "(expected rank %d)\n", left);
+        ::close(fd);  // stray/garbled: keep accepting
+      }
+    }
+    connector.join();
+    peer_out_fd_ = out_fd.load();
+    peer_in_fd_ = in_fd;
+    if (peer_out_fd_ >= 0 && peer_in_fd_ >= 0) return true;
+    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
+    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
+    return false;
+  }
+
+  // Raw fixed-size exchange with both neighbors: send `snd` right while
+  // receiving `rcv_n` bytes from the left. The send rides a helper thread
+  // so a full TCP buffer cannot deadlock the step (everyone sends and
+  // receives simultaneously).
+  bool RingStep(const char* snd, size_t snd_n, char* rcv, size_t rcv_n) {
+    std::atomic<bool> send_ok{true};
+    std::thread sender([&] {
+      size_t off = 0;
+      while (off < snd_n) {
+        ssize_t n = ::send(peer_out_fd_, snd + off, snd_n - off,
+                           MSG_NOSIGNAL);
+        if (n <= 0) { send_ok.store(false); return; }
+        off += static_cast<size_t>(n);
+      }
+    });
+    bool recv_ok = rcv_n == 0 || RecvAll(peer_in_fd_, rcv, rcv_n);
+    sender.join();
+    if (send_ok.load()) ring_bytes_sent_ += snd_n;
+    return send_ok.load() && recv_ok;
+  }
+
+  bool RunRing(const Response& plan, RingWork work, std::string* out) {
+    if (!EnsurePeers(plan.ring_peers)) return false;
+    const int N = size_;
+    std::string& buf = work.payload;
+    const size_t esz = static_cast<size_t>(DTypeSize(work.dtype));
+    const size_t elems = buf.size() / esz;
+    // Element-aligned chunk boundaries [off[i], off[i+1]) in bytes.
+    std::vector<size_t> off(N + 1);
+    for (int i = 0; i <= N; i++)
+      off[i] = (elems * i / N) * esz;
+    std::string incoming(off[1] - off[0] + esz, '\0');  // max chunk size
+    auto chunk = [&](int i) { return &buf[0] + off[i]; };
+    auto clen = [&](int i) { return off[i + 1] - off[i]; };
+
+    // Phase 1: reduce-scatter. After step s, the chunk received at
+    // (r - s - 1) holds the partial sum of s+2 ranks; after N-2 steps rank
+    // r owns the fully reduced chunk (r + 1) % N.
+    for (int s = 0; s <= N - 2; s++) {
+      int snd = (rank_ - s + N) % N;
+      int rcv = (rank_ - s - 1 + N) % N;
+      if (!RingStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
+        return false;
+      // In-place accumulate; order differs from the star's rank-order
+      // reduce only in float rounding (as MPI's ring does).
+      ReducePayloadRaw(work.dtype, work.red_op, chunk(rcv), incoming.data(),
+                       clen(rcv));
+    }
+    // Phase 2: allgather of the reduced chunks around the ring.
+    for (int s = 0; s <= N - 2; s++) {
+      int snd = (rank_ + 1 - s + N) % N;
+      int rcv = (rank_ - s + N) % N;
+      if (!RingStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
+        return false;
+      memcpy(chunk(rcv), incoming.data(), clen(rcv));
+    }
+    ring_ops_++;
+    *out = std::move(buf);
+    return true;
+  }
+
   void RecvLoop() {
     while (!shutdown_.load()) {
       std::string body;
@@ -1244,8 +1544,34 @@ class Client {
       if (tag != MsgTag::kResponse) break;
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
+      if (resp.type == RespType::kAllreduceRing) {
+        RingWork work;
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it == ring_pending_.end()) break;  // protocol violation
+          work = std::move(it->second);
+          ring_pending_.erase(it);
+        }
+        std::string reduced;
+        if (!RunRing(resp, std::move(work), &reduced)) break;
+        resp.type = RespType::kAllreduce;
+        resp.payload = std::move(reduced);
+      } else if (resp.type == RespType::kError) {
+        // A rejected ring announcement still holds the stashed payload.
+        std::lock_guard<std::mutex> l(ring_mu_);
+        ring_pending_.erase(resp.name);
+      }
       std::lock_guard<std::mutex> l(mu_);
       responses_received_++;
+      // Late response to a wait that already timed out (strict stall
+      // mode): count it completed but drop the payload — nobody will
+      // ever redeem it.
+      auto deliver = [&](Response&& one) {
+        ops_completed_++;
+        if (abandoned_.erase(one.name) > 0) return;
+        completed_[one.name] = std::move(one);
+      };
       if (!resp.fused_names.empty()) {
         // Fused frame: split the concatenated payload back into the
         // individual ops it answers (reference: one MPIResponse completes
@@ -1258,12 +1584,10 @@ class Client {
           size_t n = static_cast<size_t>(resp.fused_nbytes[i]);
           one.payload = resp.payload.substr(off, n);
           off += n;
-          ops_completed_++;
-          completed_[one.name] = std::move(one);
+          deliver(std::move(one));
         }
       } else {
-        ops_completed_++;
-        completed_[resp.name] = std::move(resp);
+        deliver(std::move(resp));
       }
       cv_.notify_all();
     }
@@ -1284,21 +1608,36 @@ class Client {
     std::lock_guard<std::mutex> l(mu_);
     return ops_completed_;
   }
+  // Ring observability (the byte-accounting proof that large allreduces
+  // move <= ~2x bytes per rank regardless of world size).
+  long long ring_ops() { return ring_ops_.load(); }
+  long long ring_bytes_sent() { return ring_bytes_sent_.load(); }
 
  private:
   long long responses_received_ = 0;
   long long ops_completed_ = 0;
+  std::atomic<long long> ring_ops_{0};
+  std::atomic<long long> ring_bytes_sent_{0};
 
   int32_t rank_;
   int size_;
   int fd_ = -1;
   bool connected_ = false;
+  int64_t ring_threshold_ = 0;
+  double stall_timeout_secs_ = 0;
+  int peer_listen_fd_ = -1;
+  int peer_port_ = 0;
+  int peer_out_fd_ = -1;  // to right neighbor (recv-thread only)
+  int peer_in_fd_ = -1;   // from left neighbor (recv-thread only)
+  std::mutex ring_mu_;
+  std::map<std::string, RingWork> ring_pending_;
   std::mutex send_mu_;
   std::thread recv_thread_;
   std::atomic<bool> shutdown_{false};
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, Response> completed_;
+  std::set<std::string> abandoned_;  // stall-timed-out names (guarded by mu_)
   bool dead_ = false;
 };
 
@@ -1387,7 +1726,7 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
   if (data && nbytes > 0)
     req.payload.assign(reinterpret_cast<const char*>(data),
                        static_cast<size_t>(nbytes));
-  if (!G->client->Enqueue(req)) {
+  if (!G->client->Submit(std::move(req))) {
     snprintf(err, errlen, "hvdcoord: send failed (coordinator down?)");
     return 2;
   }
@@ -1398,7 +1737,8 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
 //   0 ok; fills *out (malloc'd; caller frees via hvdcoord_free), *out_nbytes,
 //     and for allgather writes per-rank first dims into sizes_out[size].
 //   1 coordinator-reported validation error (message in err, FailedPrecondition
-//     parity, mpi_ops.cc:1141-1148); 2 transport failure.
+//     parity, mpi_ops.cc:1141-1148); 2 transport failure; 3 stall deadline
+//     exceeded (HOROVOD_STALL_TIMEOUT strict mode -> StalledError).
 int hvdcoord_wait(const char* name, void** out, long long* out_nbytes,
                   long long* sizes_out, char* err, int errlen) {
   using namespace hvdcoord;
@@ -1408,7 +1748,16 @@ int hvdcoord_wait(const char* name, void** out, long long* out_nbytes,
     return 2;
   }
   Response resp;
-  if (!G->client->Wait(name, &resp)) {
+  int wrc = G->client->Wait(name, &resp);
+  if (wrc == 2) {
+    snprintf(err, errlen,
+             "collective %s exceeded HOROVOD_STALL_TIMEOUT: one or more "
+             "ranks never announced it (see the coordinator's stall "
+             "warning for the ready-rank list)",
+             name);
+    return 3;
+  }
+  if (wrc != 0) {
     snprintf(err, errlen, "hvdcoord: connection lost while waiting for %s",
              name);
     return 2;
@@ -1448,6 +1797,18 @@ long long hvdcoord_responses_received() {
 long long hvdcoord_ops_completed() {
   using namespace hvdcoord;
   return g()->client ? g()->client->ops_completed() : -1;
+}
+
+// Ring-plane observability: ops that took the client-to-client ring, and
+// the data-plane bytes this rank sent for them (2·(N-1)/N · payload per op
+// — the bandwidth-optimality proof, independent of world size).
+long long hvdcoord_ring_ops() {
+  using namespace hvdcoord;
+  return g()->client ? g()->client->ring_ops() : -1;
+}
+long long hvdcoord_ring_bytes_sent() {
+  using namespace hvdcoord;
+  return g()->client ? g()->client->ring_bytes_sent() : -1;
 }
 
 void hvdcoord_free(void* p) { free(p); }

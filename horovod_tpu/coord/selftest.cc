@@ -10,8 +10,8 @@
 //     a validation-error round, and clean shutdown.
 //
 // Build/run (see Makefile `selftest` / `tsan` targets):
-//   g++ -std=c++14 -O2 -pthread [-fsanitize=thread] \
-//       -o selftest selftest.cc ; ./selftest
+//   g++ -std=c++14 -O2 -pthread [-fsanitize=thread] -o selftest selftest.cc
+//   ./selftest
 //
 // The coordinator implementation is #included so the test sees the same
 // code the .so ships, without exporting internal symbols.
@@ -68,7 +68,7 @@ void RankMain(int rank, std::atomic<int>* failures) {
           return;
         }
         Response resp;
-        if (!client.Wait(req.name, &resp) ||
+        if (client.Wait(req.name, &resp) != 0 ||
             resp.type != hvdcoord::RespType::kAllreduce) {
           failures->fetch_add(1);
           return;
@@ -102,7 +102,7 @@ void RankMain(int rank, std::atomic<int>* failures) {
   }
   for (auto it = names.rbegin(); it != names.rend(); ++it) {  // reverse
     Response resp;
-    if (!client.Wait(*it, &resp)) failures->fetch_add(1);
+    if (client.Wait(*it, &resp) != 0) failures->fetch_add(1);
   }
 
   // A cross-rank validation error must surface as kError on every rank.
@@ -117,11 +117,57 @@ void RankMain(int rank, std::atomic<int>* failures) {
                               (rank == 0 ? 4 : 8), '\0');
     client.Enqueue(req);
     Response resp;
-    if (!client.Wait(req.name, &resp) ||
+    if (client.Wait(req.name, &resp) != 0 ||
         resp.type != hvdcoord::RespType::kError ||
         resp.error.find("Mismatched data types") == std::string::npos) {
       failures->fetch_add(1);
     }
+  }
+
+  // Ring round: a payload over HOROVOD_RING_THRESHOLD (set tiny in main)
+  // takes the client-to-client ring data plane — exercises the peer
+  // connect/accept handshake, the per-step sender threads, and the
+  // in-place chunk reduction under TSan.
+  for (int round = 0; round < 3; round++) {
+    std::vector<float> v(1000);
+    for (int i = 0; i < 1000; i++) v[i] = float(rank) + float(i);
+    Request req;
+    req.rank = rank;
+    req.type = ReqType::kAllreduce;
+    req.dtype = DType::kF32;
+    req.red_op = RedOp::kSum;
+    req.shape = {1000};
+    std::string name = "ring.big." + std::to_string(round);
+    req.name = name;
+    req.payload = F32Payload(v);
+    if (!client.Submit(std::move(req))) failures->fetch_add(1);
+    Response resp;
+    if (client.Wait(name, &resp) != 0 ||
+        resp.type != hvdcoord::RespType::kAllreduce ||
+        resp.payload.size() != 4000) {
+      failures->fetch_add(1);
+    } else {
+      const float* out =
+          reinterpret_cast<const float*>(resp.payload.data());
+      float rsum = 0.f;
+      for (int r = 0; r < kSize; r++) rsum += float(r);
+      for (int i : {0, 333, 334, 666, 667, 999}) {
+        if (std::fabs(out[i] - (rsum + kSize * float(i))) > 1e-3) {
+          failures->fetch_add(1);
+          break;
+        }
+      }
+    }
+  }
+  if (client.ring_ops() != 3) failures->fetch_add(1);
+  // Bandwidth optimality: each ring op moves 2*(N-1)/N * payload per rank
+  // (up to one element of chunk-remainder skew per send).
+  long long expect = 3LL * 2 * (kSize - 1) * 4000 / kSize;
+  long long sent = client.ring_bytes_sent();
+  if (sent < expect - 64 || sent > expect + 64) {
+    fprintf(stderr, "rank %d: ring bytes %lld !~ %lld\n", rank, sent,
+            expect);
+    failures->fetch_add(1);
   }
 
   client.Shutdown();
@@ -130,6 +176,7 @@ void RankMain(int rank, std::atomic<int>* failures) {
 }  // namespace
 
 int main() {
+  setenv("HOROVOD_RING_THRESHOLD", "64", 1);  // ring the 4 KB round
   std::atomic<int> failures{0};
   Coordinator coordinator(kSize, kPort, 64 << 20, 60.0, "");
   if (!coordinator.ok()) {
@@ -145,7 +192,7 @@ int main() {
     return 1;
   }
   printf("hvdcoord selftest OK (%d ranks x %d threads x %d ops + burst + "
-         "error round)\n",
+         "error round + ring rounds)\n",
          kSize, kThreadsPerRank, kOpsPerThread);
   return 0;
 }

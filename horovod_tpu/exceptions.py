@@ -49,8 +49,12 @@ class TransportError(HorovodError):
 
 
 class StalledError(HorovodError):
-    """A collective waited past the hard stall deadline (optional strict mode).
+    """A collective waited past the hard stall deadline (strict mode).
 
-    The reference only warns (``CheckForStalledTensors``,
-    ``mpi_ops.cc:1153-1196``); we additionally support a hard timeout.
+    Enabled by ``HOROVOD_STALL_TIMEOUT=<seconds>`` (0 = off, the default):
+    an eager collective whose response does not arrive within the deadline
+    — e.g. because another rank never announced it — raises this instead
+    of blocking forever. The reference only warns
+    (``CheckForStalledTensors``, ``mpi_ops.cc:1153-1196``); the hard
+    timeout is a TPU-era extension for fail-fast fleet jobs.
     """

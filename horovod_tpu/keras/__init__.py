@@ -101,25 +101,66 @@ def DistributedOptimizer(optimizer, *, average: bool = True,
         _hvd_average = average
         _hvd_compression = compression
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        def apply(self, grads, trainable_variables=None):
+            # `apply` is the single funnel in Keras 3: the TF trainer's
+            # `apply_gradients` and the jax trainer's `stateless_apply`
+            # both land here, so hooking it covers every backend's
+            # compiled train step.
             if runtime.is_initialized() and runtime.size() > 1:
-                grads_and_vars = [
-                    (self._hvd_allreduce_grad(g, v), v)
-                    for g, v in grads_and_vars
-                ]
-            return super().apply_gradients(grads_and_vars, *args, **kwargs)
+                grads = list(grads)
+                variables = (list(trainable_variables)
+                             if trainable_variables is not None
+                             else list(self._trainable_variables))
+                idx = [i for i, g in enumerate(grads) if g is not None]
+                if idx:
+                    reduced = self._hvd_allreduce_grads(
+                        [grads[i] for i in idx],
+                        [variables[i] for i in idx])
+                    for i, g in zip(idx, reduced):
+                        grads[i] = g
+            return super().apply(grads, trainable_variables)
 
-        def _hvd_allreduce_grad(self, grad, var):
-            if grad is None:
-                return None
-            op_name = f"grad.{getattr(var, 'path', var.name)}"
+        def _hvd_allreduce_grads(self, grads, variables):
+            """Allreduce the whole gradient list through ONE host callback.
 
-            def _reduce_np(g_np):
-                arr = np.asarray(g_np)
-                c, ctx = self._hvd_compression.compress(arr)
-                out = allreduce(c, average=self._hvd_average, name=op_name)
-                return np.asarray(
-                    self._hvd_compression.decompress(out, ctx))
+            A single callback (not one per gradient) matters in
+            multi-process worlds: independent per-tensor callbacks may
+            execute in different orders on different ranks, each blocking
+            on a different collective — a deadlock the reference's
+            coordinator avoids because TF's enqueue is asynchronous
+            (mpi_ops.cc:1752-1772). One callback per step keeps every rank
+            announcing the same batch, and the async submit-all/wait-all
+            inside feeds the coordinator's response fusion.
+            """
+            names = [f"grad.{getattr(v, 'path', v.name)}" for v in variables]
+
+            def _reduce_all_np(*gs):
+                arrs = [np.asarray(g) for g in gs]
+                w = runtime.world()
+                if w.coord is not None:
+                    # Multi-process: overlap every announcement (fusion),
+                    # then redeem in order.
+                    compressed = [self._hvd_compression.compress(a)
+                                  for a in arrs]
+                    handles = [
+                        w.coord.submit("allreduce", c, name,
+                                       op=Op.AVERAGE if self._hvd_average
+                                       else Op.SUM)
+                        for (c, _), name in zip(compressed, names)]
+                    outs = [
+                        np.asarray(self._hvd_compression.decompress(
+                            w.coord.wait(h), ctx))
+                        for h, (_, ctx) in zip(handles, compressed)]
+                else:
+                    outs = []
+                    for a, name in zip(arrs, names):
+                        c, ctx = self._hvd_compression.compress(a)
+                        out = _allreduce(c, average=self._hvd_average,
+                                         name=name)
+                        outs.append(np.asarray(
+                            self._hvd_compression.decompress(out, ctx)))
+                return tuple(np.ascontiguousarray(o.astype(a.dtype))
+                             for o, a in zip(outs, arrs))
 
             # Keras compiles train steps per backend; bridge the collective
             # through the backend's host-callback mechanism so it works
@@ -128,21 +169,27 @@ def DistributedOptimizer(optimizer, *, average: bool = True,
             if backend == "tensorflow":
                 import tensorflow as tf
                 if not tf.executing_eagerly():  # inside tf.function
-                    out = tf.py_function(
-                        lambda g: tf.constant(_reduce_np(g.numpy())),
-                        [grad], Tout=grad.dtype)
-                    out.set_shape(grad.shape)
-                    return out
+                    outs = tf.py_function(
+                        lambda *gs: [tf.constant(o) for o in
+                                     _reduce_all_np(*[g.numpy()
+                                                      for g in gs])],
+                        list(grads), Tout=[g.dtype for g in grads])
+                    for o, g in zip(outs, grads):
+                        o.set_shape(g.shape)
+                    return list(outs)
             elif backend == "jax":
                 import jax as _jax
                 import jax.core as _jcore
-                if isinstance(grad, _jcore.Tracer):  # inside jit
-                    return _jax.pure_callback(
-                        _reduce_np,
-                        _jax.ShapeDtypeStruct(grad.shape, grad.dtype),
-                        grad)
-            out = _reduce_np(keras.ops.convert_to_numpy(grad))
-            return keras.ops.convert_to_tensor(out, dtype=grad.dtype)
+                if any(isinstance(g, _jcore.Tracer) for g in grads):
+                    out_shapes = tuple(
+                        _jax.ShapeDtypeStruct(g.shape, g.dtype)
+                        for g in grads)
+                    return list(_jax.pure_callback(
+                        _reduce_all_np, out_shapes, *grads))
+            outs = _reduce_all_np(*[keras.ops.convert_to_numpy(g)
+                                    for g in grads])
+            return [keras.ops.convert_to_tensor(o, dtype=g.dtype)
+                    for o, g in zip(outs, grads)]
 
     _Distributed.__name__ = cls_name
     _Distributed.__qualname__ = cls_name

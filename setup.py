@@ -33,6 +33,8 @@ setup(
                                         "Makefile"]},
     python_requires=">=3.10",
     install_requires=["jax", "flax", "optax", "orbax-checkpoint", "numpy"],
+    # "digits" real-dataset loader (data.load_dataset) needs sklearn.
+    extras_require={"datasets": ["scikit-learn"]},
     scripts=["bin/tpurun"],
     cmdclass={"build_py": BuildWithNativeCore},
 )

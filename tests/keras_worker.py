@@ -1,0 +1,68 @@
+"""Worker for the multi-process Keras fit test (run under `tpurun -np 2`).
+
+The reference CI's Keras analog: `.travis.yml:93-108` runs keras examples
+under `mpirun -np 2`. Here Keras (jax backend) jits its train step, so each
+gradient exchange crosses into the env-world coordination plane through the
+adapter's single pure_callback bridge; ranks start from DIFFERENT seeds and
+train on DIFFERENT data shards — only the broadcast callback plus the
+per-step gradient allreduce can make them converge to identical weights.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import keras
+
+import horovod_tpu as hvd
+import horovod_tpu.keras as hvd_keras
+
+hvd.init()
+assert hvd.process_count() == 2, hvd.process_count()
+rank = hvd.rank()
+
+keras.utils.set_random_seed(100 + rank)  # deliberately divergent init
+model = keras.Sequential([
+    keras.layers.Input((4,)),
+    keras.layers.Dense(8, activation="relu"),
+    keras.layers.Dense(3),
+])
+model.compile(
+    optimizer=hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.05)),
+    loss="sparse_categorical_crossentropy")
+
+rng = np.random.RandomState(rank)  # different shard per rank
+x = rng.randn(64, 4).astype(np.float32)
+w = np.random.RandomState(42).randn(4, 3).astype(np.float32)
+y = np.argmax(x @ w, axis=1)
+
+h = model.fit(x, y, epochs=3, batch_size=16, verbose=0,
+              callbacks=[hvd_keras.BroadcastGlobalVariablesCallback(0),
+                         hvd_keras.MetricAverageCallback()])
+losses = h.history["loss"]
+assert losses[-1] < losses[0], losses
+
+# Weights must be bit-identical across ranks: broadcast aligned the starts,
+# the averaged gradients kept every step in lockstep.
+digest = np.concatenate([np.asarray(v).ravel() for v in model.get_weights()])
+gathered = np.asarray(hvd.allgather(
+    jnp.asarray(digest.reshape(1, -1)), name="keras.digest"))
+assert gathered.shape[0] == 2, gathered.shape
+max_dev = float(np.abs(gathered[0] - gathered[1]).max())
+assert max_dev < 1e-6, max_dev
+
+# Metric averaging crossed processes too (losses differ per shard before
+# averaging; after MetricAverageCallback both ranks log the same number).
+peer_losses = np.asarray(hvd.allgather(
+    jnp.asarray([[losses[-1]]], jnp.float32), name="keras.loss"))
+assert abs(float(peer_losses[0, 0]) - float(peer_losses[1, 0])) < 1e-6
+
+print(f"rank {rank}: KERAS_FIT_OK loss={losses[0]:.4f}->{losses[-1]:.4f} "
+      f"weight_dev={max_dev:.2e}", flush=True)
+hvd.shutdown()

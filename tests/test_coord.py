@@ -53,6 +53,155 @@ def test_coord_world(size):
         assert f"rank {rank}: OK" in out
 
 
+def test_ring_allreduce_large_payload_bandwidth_optimal():
+    """An allreduce at/above HOROVOD_RING_THRESHOLD rides the
+    client-to-client chunked ring (reduce-scatter + allgather): the result
+    matches the star plane, and EVERY rank — including rank 0, which in
+    star mode would relay N x payload — sends ~2·(N-1)/N · payload bytes,
+    independent of world size (the reference's MPI_Allreduce ring,
+    mpi_ops.cc:1061-1064)."""
+    import textwrap
+    size = 4
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, {size}, "127.0.0.1", {port})
+        n = 1 << 20                     # 4 MiB of f32
+        x = np.arange(n, dtype=np.float32) * 0 + float(rank + 1)
+        out = np.asarray(c.collective("allreduce", x, "big.ring",
+                                      ))
+        assert out.shape == (n,), out.shape
+        assert np.allclose(out, 10.0), out[:4]   # 1+2+3+4
+        # A second large one with distinctive per-position values (catches
+        # chunk-boundary/indexing bugs, not just uniform sums).
+        y = (np.arange(n, dtype=np.float32) % 1000) * (rank + 1)
+        out2 = np.asarray(c.collective("allreduce", y, "big.ring2"))
+        expect2 = (np.arange(n, dtype=np.float32) % 1000) * 10.0
+        assert np.allclose(out2, expect2), np.abs(out2 - expect2).max()
+        # Small ops still take the star (below threshold).
+        s = np.asarray(c.collective("allreduce",
+                                    np.ones(4, np.float32), "small.star"))
+        assert np.allclose(s, float({size})), s
+        assert c.ring_ops() == 2, c.ring_ops()
+        nbytes = 2 * 4 * n              # two ring ops of 4 MiB
+        sent = c.ring_bytes_sent()
+        optimal = 2 * ({size} - 1) * nbytes // {size}
+        assert abs(sent - optimal) <= 64, (sent, optimal)
+        assert sent <= 2 * nbytes       # the <= ~2x-bytes-per-rank bound
+        print(f"rank {{rank}}: RING_OK sent={{sent}}", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu", HOROVOD_RING_THRESHOLD="1048576")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: RING_OK" in out
+
+
+def test_ring_threshold_skew_is_a_named_validation_error():
+    """If HOROVOD_RING_THRESHOLD disagrees across ranks the same tensor is
+    announced ALLREDUCE_RING on one rank and ALLREDUCE on another — that
+    must surface as the standard mismatched-collective
+    FailedPreconditionError on every rank, not a hang."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import FailedPreconditionError
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 2, "127.0.0.1", {port})
+        x = np.ones(4096, np.float32)   # 16 KiB: rings on rank 0 only
+        try:
+            c.collective("allreduce", x, "skewed")
+            print(f"rank {{rank}}: NO ERROR", flush=True)
+        except FailedPreconditionError as e:
+            assert "Mismatched collective operations" in str(e), e
+            assert "ALLREDUCE_RING" in str(e), e
+            print(f"rank {{rank}}: SKEW_REJECTED", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu",
+                   HOROVOD_RING_THRESHOLD="1024" if rank == 0 else "0")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: SKEW_REJECTED" in out
+
+
+def test_stall_timeout_strict_mode_raises_stalled_error():
+    """HOROVOD_STALL_TIMEOUT turns the reference's stall *warning* into a
+    hard failure: a collective only a subset of ranks announced raises
+    StalledError after the deadline instead of blocking forever — and the
+    world remains usable for subsequent collectives."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import StalledError
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 2, "127.0.0.1", {port})
+        if rank == 0:
+            t0 = time.monotonic()
+            try:
+                c.collective("allreduce", np.ones(3, np.float32), "lonely")
+                print("rank 0: NO ERROR", flush=True)
+            except StalledError as e:
+                dt = time.monotonic() - t0
+                assert "HOROVOD_STALL_TIMEOUT" in str(e), e
+                assert "lonely" in str(e), e
+                assert dt < 30, dt
+                print(f"rank 0: STALLED after {{dt:.1f}}s", flush=True)
+        # Both ranks: the world still works after the strict failure.
+        out = np.asarray(c.collective(
+            "allreduce", np.ones(2, np.float32), "after"))
+        assert np.allclose(out, 2.0), out
+        print(f"rank {{rank}}: AFTER_OK", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(2):
+        # Rank 1 gets a much longer deadline: its wait on "after" spans
+        # rank 0's full 2 s timeout, and must not itself trip.
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu",
+                   HOROVOD_STALL_TIMEOUT="2" if rank == 0 else "60")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: AFTER_OK" in out
+        outs.append(out)
+    assert "STALLED after" in outs[0], outs[0]
+
+
 def test_rank_death_mid_collective_propagates_transport_error():
     """Kill one rank mid-collective: every survivor must get a clean
     TransportError (not a hang) via the coordinated-shutdown-on-client-death
@@ -95,24 +244,42 @@ def test_rank_death_mid_collective_propagates_transport_error():
         assert "TRANSPORT_ERROR" in outs[rank], (rank, outs[rank])
 
 
+def _wait_port_listening(port: int, timeout: float = 60.0) -> None:
+    """Poll until something accepts on 127.0.0.1:port (readiness probe —
+    no fixed sleeps; load-insensitive)."""
+    import socket as socket_mod
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            s = socket_mod.create_connection(("127.0.0.1", port),
+                                             timeout=1.0)
+            s.close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"nothing listening on port {port}")
+
+
 def test_stray_client_does_not_kill_coordinator():
     """A junk/duplicate/out-of-range hello must be rejected without killing
     the accept loop: the real world still forms and completes collectives."""
     import socket as socket_mod
     import struct
     import textwrap
-    import threading
     port = _free_port()
 
     def _harass():
         # Out-of-range rank, duplicate rank, wrong world size, wrong
-        # protocol version, and a junk frame — each must be rejected with a
-        # hello-ack naming the reason, without hurting the real world.
-        hellos = (struct.pack("<iii", 99, 2, 2),   # out-of-range rank
-                  struct.pack("<iii", 0, 2, 2),    # duplicate rank 0
-                  struct.pack("<iii", 1, 5, 2),    # world-size mismatch
-                  struct.pack("<iii", 1, 2, 99),   # protocol mismatch
-                  b"xx")                           # junk
+        # protocol version, a stale 12-byte v2 hello, and a junk frame —
+        # each must be rejected with a hello-ack naming the reason, without
+        # hurting the real world. (v3 hello: rank, size, version, peer_port)
+        hellos = (struct.pack("<iiii", 99, 2, 3, 0),  # out-of-range rank
+                  struct.pack("<iiii", 0, 2, 3, 0),   # duplicate rank 0
+                  struct.pack("<iiii", 1, 5, 3, 0),   # world-size mismatch
+                  struct.pack("<iiii", 1, 2, 99, 0),  # protocol mismatch
+                  struct.pack("<iii", 1, 2, 2),       # old-build 12B hello
+                  b"xx")                              # junk
         for hello in hellos:
             try:
                 s = socket_mod.create_connection(("127.0.0.1", port),
@@ -125,14 +292,12 @@ def test_stray_client_does_not_kill_coordinator():
                 pass
 
     script = textwrap.dedent(f"""
-        import os, sys, time
+        import os, sys
         sys.path.insert(0, {os.path.dirname(HERE)!r})
         import numpy as np
         from horovod_tpu.coord.client import CoordClient
 
         rank = int(os.environ["HVD_RANK"])
-        if rank == 1:
-            time.sleep(1.0)  # let the stray hellos land first
         c = CoordClient(rank, 2, "127.0.0.1", {port})
         out = np.asarray(c.collective(
             "allreduce", np.ones(3, np.float32), "t.ok"))
@@ -140,19 +305,21 @@ def test_stray_client_does_not_kill_coordinator():
         print(f"rank {{rank}}: OK", flush=True)
         c.shutdown()
     """)
-    procs = []
-    for rank in range(2):
+
+    def _spawn(rank):
         env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
                    JAX_PLATFORMS="cpu")
-        procs.append(subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-c", script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    # Rank 0 hosts the coordinator; give it a moment to bind, then harass.
-    import time
-    time.sleep(0.8)
-    t = threading.Thread(target=_harass)
-    t.start()
-    t.join()
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    # Rank 0 hosts the coordinator. Poll for the listening socket (no fixed
+    # sleep), harass it, and only then let rank 1 join — the stray hellos
+    # deterministically land before the legitimate rank-1 hello.
+    procs = [_spawn(0)]
+    _wait_port_listening(port)
+    _harass()
+    procs.append(_spawn(1))
     for rank, p in enumerate(procs):
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {rank}:\n{out}"
@@ -188,18 +355,23 @@ def test_world_size_mismatch_fails_fast_with_message():
         c.shutdown()
     """)
     # Coordinator world is size 2; rank 1 joins twice — once with the wrong
-    # size (rejected), then with the right one (admitted).
-    cfgs = [(0, 2), (1, 5), (1, 2)]
-    procs = []
-    for i, (rank, size) in enumerate(cfgs):
+    # size (rejected), then with the right one (admitted). Join order is
+    # made deterministic by WAITING on each gate (port listening; rejected
+    # process exiting) instead of sleeping.
+    def _spawn(rank, size):
         env = dict(os.environ, HVD_RANK=str(rank), HVD_SIZE=str(size),
                    PYTHONPATH="", JAX_PLATFORMS="cpu")
-        procs.append(subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-c", script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-        import time
-        time.sleep(0.5)  # deterministic join order
-    outs = [p.communicate(timeout=120)[0] for p in procs]
-    assert "MISMATCH_DETECTED" in outs[1], outs[1]
-    assert "rank 0: OK" in outs[0], outs[0]
-    assert "rank 1: OK" in outs[2], outs[2]
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    p0 = _spawn(0, 2)
+    _wait_port_listening(port)
+    p_bad = _spawn(1, 5)
+    out_bad = p_bad.communicate(timeout=120)[0]  # rejected -> exits first
+    p1 = _spawn(1, 2)
+    out0 = p0.communicate(timeout=120)[0]
+    out1 = p1.communicate(timeout=120)[0]
+    assert "MISMATCH_DETECTED" in out_bad, out_bad
+    assert "rank 0: OK" in out0, out0
+    assert "rank 1: OK" in out1, out1

@@ -63,6 +63,32 @@ class TestDistributedOptimizer:
         losses = h.history["loss"]
         assert losses[-1] < losses[0], losses
 
+    def test_fit_under_tpurun_two_processes(self):
+        """Keras fit under `tpurun -np 2` (the reference CI runs Keras
+        under `mpirun -np 2`, .travis.yml:93-108): ranks start from
+        different seeds and shards; the broadcast callback + the per-step
+        gradient allreduce through the host-callback bridge must converge
+        them to bit-identical weights, and MetricAverageCallback must
+        produce identical logged losses. The worker forces the jax
+        backend, whose trainer path (stateless_apply -> apply) is the one
+        that needs the pure_callback bridge."""
+        import os
+        import subprocess
+        import sys
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "keras_worker.py")
+        env = dict(os.environ, PYTHONPATH="", KERAS_BACKEND="jax")
+        env.pop("HVD_RANK", None)
+        env.pop("HVD_SIZE", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.launcher", "-np", "2",
+             sys.executable, worker],
+            env=env, capture_output=True, text=True, timeout=400)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "rank 0: KERAS_FIT_OK" in r.stdout, r.stdout
+        assert "rank 1: KERAS_FIT_OK" in r.stdout, r.stdout
+        assert "weight_dev=0.00e+00" in r.stdout, r.stdout
+
     def test_fit_trains(self):
         keras.utils.set_random_seed(2)  # verified-converging init
         model = _tiny_model()
