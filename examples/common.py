@@ -20,6 +20,7 @@ if _ROOT not in sys.path:
 
 import numpy as np
 
+from horovod_tpu.data import _synthetic  # noqa: F401  (imagenet example)
 from horovod_tpu.data import load_dataset  # framework-level loader
 
 

@@ -114,6 +114,9 @@ class CoordClient:
         # in-flight name (Ingest), so a second submit under the same name
         # would wait forever; fail fast here instead.
         self._inflight: set = set()
+        # Names whose wait raised StalledError: still half-announced at
+        # the coordinator, permanently unusable for resubmission.
+        self._stalled: set = set()
         # The coordinator (not Python) writes the timeline in coord mode.
         self.timeline = None
 
@@ -160,6 +163,17 @@ class CoordClient:
             raise TypeError(f"unsupported dtype {dtype_name} for eager "
                             f"coordination-plane collective")
 
+        if name in self._stalled:
+            # The earlier collective under this name timed out
+            # (HOROVOD_STALL_TIMEOUT) but is STILL half-announced at the
+            # coordinator; re-announcing would be silently dropped as a
+            # duplicate and could pair this step's payload on other ranks
+            # with our stale one. Fail fast with the reason.
+            raise ValueError(
+                f"tensor name {name!r} previously raised StalledError and "
+                f"is still pending at the coordinator; a stalled "
+                f"collective cannot be retried under the same name — use "
+                f"a fresh name (name=None auto-names)")
         if name in self._inflight:
             raise ValueError(
                 f"tensor name {name!r} is already in flight on rank "
@@ -204,6 +218,7 @@ class CoordClient:
         if rc == 3:
             # HOROVOD_STALL_TIMEOUT strict mode (the reference only warns,
             # mpi_ops.cc:1153-1196; the hard deadline is a TPU-era extra).
+            self._stalled.add(handle.name)
             raise StalledError(err.value.decode())
         if rc != 0:
             raise TransportError(err.value.decode())

@@ -1219,6 +1219,14 @@ class Client {
       stall_timeout_secs_ = atof(t);
       if (stall_timeout_secs_ < 0) stall_timeout_secs_ = 0;
     }
+    // Ring data-plane IO bound (seconds): peer connect/accept and every
+    // per-chunk send/recv must finish within it, so a rank dying mid-ring
+    // degrades to a TransportError on the survivors instead of an
+    // unbounded block on a silent socket.
+    if (const char* t = getenv("HOROVOD_RING_IO_TIMEOUT")) {
+      ring_io_secs_ = atoi(t);
+      if (ring_io_secs_ < 1) ring_io_secs_ = 1;
+    }
     // Peer-listen socket for the ring data plane (ephemeral port, announced
     // in the hello; the left ring neighbor connects here).
     peer_listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -1408,7 +1416,8 @@ class Client {
       size_t c = addr.rfind(':');
       std::string ip = addr.substr(0, c);
       int pport = atoi(addr.c_str() + c + 1);
-      for (int attempt = 0; attempt < 600; attempt++) {
+      int attempts = ring_io_secs_ * 1000 / 50;
+      for (int attempt = 0; attempt < attempts; attempt++) {
         int s = ::socket(AF_INET, SOCK_STREAM, 0);
         sockaddr_in a{};
         a.sin_family = AF_INET;
@@ -1417,6 +1426,11 @@ class Client {
         if (::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0) {
           int one = 1;
           setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          // Bound every future chunk send: a dead receiver with full TCP
+          // buffers must not block the sender thread forever.
+          timeval io_timeout{ring_io_secs_, 0};
+          setsockopt(s, SOL_SOCKET, SO_SNDTIMEO, &io_timeout,
+                     sizeof(io_timeout));
           int32_t me = rank_;
           if (::send(s, &me, 4, MSG_NOSIGNAL) == 4) {
             out_fd.store(s);
@@ -1437,7 +1451,7 @@ class Client {
     // neighbor shows up or the deadline passes.
     int in_fd = -1;
     auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::seconds(30);
+                    std::chrono::seconds(ring_io_secs_);
     while (in_fd < 0) {
       auto left_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                          deadline - std::chrono::steady_clock::now())
@@ -1454,9 +1468,12 @@ class Client {
                  sizeof(id_timeout));
       int32_t who = -1;
       if (RecvAll(fd, &who, 4) && who == left) {
-        timeval no_timeout{0, 0};
-        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
-                   sizeof(no_timeout));
+        // Keep the IO bound for every future chunk recv: a neighbor dying
+        // mid-ring must surface as a failed step (-> TransportError), not
+        // an unbounded block that also starves the control socket.
+        timeval io_timeout{ring_io_secs_, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &io_timeout,
+                   sizeof(io_timeout));
         in_fd = fd;
       } else {
         fprintf(stderr,
@@ -1477,7 +1494,9 @@ class Client {
   // Raw fixed-size exchange with both neighbors: send `snd` right while
   // receiving `rcv_n` bytes from the left. The send rides a helper thread
   // so a full TCP buffer cannot deadlock the step (everyone sends and
-  // receives simultaneously).
+  // receives simultaneously). Thread spawn cost (~10 us) is noise against
+  // the >=MB-scale transfers the ring carries; both sockets have
+  // HOROVOD_RING_IO_TIMEOUT bounds so a dead peer fails the step.
   bool RingStep(const char* snd, size_t snd_n, char* rcv, size_t rcv_n) {
     std::atomic<bool> send_ok{true};
     std::thread sender([&] {
@@ -1545,6 +1564,12 @@ class Client {
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
       if (resp.type == RespType::kAllreduceRing) {
+        // NB: a ring op whose wait stall-timed-out keeps its stash here
+        // until the plan (or an error) arrives — if the slow ranks do
+        // announce late, the world still needs this rank's payload to
+        // complete the ring (the result is then dropped via abandoned_).
+        // A never-completing op retains its payload until shutdown; that
+        // retention is the price of not corrupting a late completion.
         RingWork work;
         {
           std::lock_guard<std::mutex> l(ring_mu_);
@@ -1591,6 +1616,11 @@ class Client {
       }
       cv_.notify_all();
     }
+    // Close the ring sockets on the way out so neighbors blocked in a
+    // ring step observe EOF immediately (fast failure cascade) instead of
+    // waiting out their IO timeout.
+    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
+    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
     std::lock_guard<std::mutex> l(mu_);
     dead_ = true;
     cv_.notify_all();
@@ -1625,6 +1655,7 @@ class Client {
   bool connected_ = false;
   int64_t ring_threshold_ = 0;
   double stall_timeout_secs_ = 0;
+  int ring_io_secs_ = 30;
   int peer_listen_fd_ = -1;
   int peer_port_ = 0;
   int peer_out_fd_ = -1;  // to right neighbor (recv-thread only)

@@ -87,30 +87,25 @@ def load_dataset(name: str, data_dir: Optional[str] = None,
         n = int(0.8 * len(x))
         info["real"] = True
         return (x[:n], y[:n]), (x[n:], y[n:]), info
-    if name == "mnist":
-        path = d and os.path.join(d, "mnist.npz")
-        if path and os.path.exists(path):
-            with np.load(path) as f:
-                info["real"] = True
-                return ((f["x_train"].reshape(-1, 784).astype(np.float32)
-                         / 255.0, f["y_train"].astype(np.int32)),
-                        (f["x_test"].reshape(-1, 784).astype(np.float32)
-                         / 255.0, f["y_test"].astype(np.int32)), info)
-        return (_synthetic(n_train, (784,), 10, 0),
-                _synthetic(n_test, (784,), 10, 1), info)
-    if name == "cifar10":
-        path = d and os.path.join(d, "cifar10.npz")
-        if path and os.path.exists(path):
-            with np.load(path) as f:
-                info["real"] = True
-                return ((f["x_train"].astype(np.float32) / 255.0,
-                         f["y_train"].astype(np.int32).ravel()),
-                        (f["x_test"].astype(np.float32) / 255.0,
-                         f["y_test"].astype(np.int32).ravel()), info)
-        return (_synthetic(n_train, (32, 32, 3), 10, 0),
-                _synthetic(n_test, (32, 32, 3), 10, 1), info)
-    raise ValueError(f"unknown dataset {name!r} "
-                     "(expected mnist/cifar10/digits)")
+    # npz datasets: name -> (x transform, synthetic stand-in shape).
+    _npz = {
+        "mnist": (lambda x: x.reshape(-1, 784), (784,)),
+        "cifar10": (lambda x: x, (32, 32, 3)),
+    }
+    if name not in _npz:
+        raise ValueError(f"unknown dataset {name!r} "
+                         "(expected mnist/cifar10/digits)")
+    x_tf, syn_shape = _npz[name]
+    path = d and os.path.join(d, f"{name}.npz")
+    if path and os.path.exists(path):
+        with np.load(path) as f:
+            info["real"] = True
+            return ((x_tf(f["x_train"]).astype(np.float32) / 255.0,
+                     f["y_train"].astype(np.int32).ravel()),
+                    (x_tf(f["x_test"]).astype(np.float32) / 255.0,
+                     f["y_test"].astype(np.int32).ravel()), info)
+    return (_synthetic(n_train, syn_shape, 10, 0),
+            _synthetic(n_test, syn_shape, 10, 1), info)
 
 
 def shard_iterator(batches: Iterable, mesh: Optional[Any] = None) -> Iterator:

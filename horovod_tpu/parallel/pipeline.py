@@ -1,13 +1,21 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over ``pp``.
+"""Pipeline parallelism over ``pp``: GPipe and a 1F1B-style schedule.
 
 Net-new TPU capability (absent from the reference). Layers are partitioned
 into S stages, one per pp rank; activations flow stage-to-stage with
-``ppermute`` (one ICI hop). A step processes M microbatches in
-M + S - 1 ticks (the classic GPipe schedule: bubble fraction
-(S-1)/(M+S-1)); every tick every stage computes, so utilization approaches
-1 as M grows. Differentiable end-to-end — ``jax.grad`` through the loop
-yields the reverse schedule automatically (ppermute transposes to the
-reverse permutation).
+``ppermute`` (one ICI hop).
+
+* :func:`gpipe` — the classic schedule: M microbatches in M + S - 1 ticks
+  (bubble fraction (S-1)/(M+S-1)). Differentiable end-to-end: ``jax.grad``
+  through the loop yields the reverse schedule automatically — but the
+  autodiff saves every tick's activations, so TRAINING memory grows O(M).
+* :func:`one_f_one_b` — a 1F1B-style training step (PipeDream-flush /
+  Megatron's non-interleaved schedule, adapted to lockstep SPMD): each
+  "double tick" every stage runs one forward and one backward, backwards
+  chasing forwards S-1 ticks behind. Only the INPUT activation of each
+  in-flight microbatch is saved (the stage forward is recomputed inside
+  its VJP), so activation memory is O(S) microbatches per stage instead of
+  O(M) — the property that makes pipeline training usable when M is large.
+  Compute is the same ~3 forwards/microbatch as gpipe-under-remat.
 """
 
 from __future__ import annotations
@@ -64,3 +72,90 @@ def gpipe(stage_fn: Callable, stage_params, x_micro, *,
     outs = lax.psum(jnp.where(r == S - 1, outs, jnp.zeros_like(outs)),
                     axis_name)
     return outs
+
+
+def one_f_one_b(stage_fn: Callable, stage_params, x_micro, y_micro,
+                loss_fn: Callable, *, axis_name: str = "pp"):
+    """Memory-bounded pipelined TRAINING step (1F1B-style schedule).
+
+    Args:
+      stage_fn: ``(params, act) -> act`` — one stage's computation.
+      stage_params: this rank's stage parameters (any pytree).
+      x_micro: [M, mb, ...] microbatched input (stage 0 consumes it).
+      y_micro: [M, mb, ...] microbatched labels (last stage consumes it).
+      loss_fn: ``(act, y) -> scalar`` per-microbatch loss, applied to the
+        LAST stage's output.
+      axis_name: pipeline mesh axis (size S).
+
+    Returns ``(loss, grads)``: the mean loss over microbatches (identical
+    on every pp rank) and this rank's ``stage_params`` gradients of it.
+
+    Schedule (global double-tick clock ``d``): stage ``r`` runs forward of
+    microbatch ``f = d - r`` and backward of microbatch
+    ``b = d - (2S - 2 - r)`` — backwards trail the last stage's forwards,
+    propagating one stage per tick, so at most ``2(S - r)`` microbatches
+    are in flight per stage and only their input activations are kept (the
+    forward is recomputed inside the VJP, the standard 1F1B + recompute
+    trade). Total ticks: ``M + 2S - 2``.
+    """
+    S = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    act_shape = x_micro.shape[1:]
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+    K = 2 * S  # saved-input ring depth >= max in-flight (2(S-r))
+
+    def dtick(d, carry):
+        in_buf, gin_buf, saved, grad_acc, loss_acc = carry
+
+        # ---- forward of microbatch f = d - r ---------------------------
+        f = d - r
+        f_valid = jnp.logical_and(f >= 0, f < M)
+        fi = jnp.clip(f, 0, M - 1)
+        x_in = jnp.where(r == 0, x_micro[fi], in_buf)
+        # Remember the input for this microbatch's backward (ring slot).
+        saved = saved.at[fi % K].set(
+            jnp.where(f_valid, x_in, saved[fi % K]))
+        act = stage_fn(stage_params, x_in)
+
+        # ---- backward of microbatch b = d - (2S - 2 - r) ---------------
+        b = d - (2 * S - 2 - r)
+        b_valid = jnp.logical_and(b >= 0, b < M)
+        bi = jnp.clip(b, 0, M - 1)
+        a_in = saved[bi % K]
+        primal, vjp = jax.vjp(stage_fn, stage_params, a_in)
+        # Cotangent: the last stage differentiates the loss at its
+        # (recomputed) output; every other stage uses the grad that
+        # arrived from downstream last tick.
+        loss_val, dact = jax.value_and_grad(loss_fn)(primal, y_micro[bi])
+        ct = jnp.where(r == S - 1, dact.astype(gin_buf.dtype), gin_buf)
+        dp, din = vjp(ct)
+        grad_acc = jax.tree_util.tree_map(
+            lambda ga, g: ga + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            grad_acc, dp)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(b_valid, r == S - 1), loss_val, 0.0)
+
+        # ---- neighbor exchange (one fwd hop, one bwd hop per tick) -----
+        in_buf = lax.ppermute(act, axis_name, fwd_perm)
+        gin_buf = lax.ppermute(din, axis_name, bwd_perm)
+        return in_buf, gin_buf, saved, grad_acc, loss_acc
+
+    carry0 = (
+        jnp.zeros(act_shape, x_micro.dtype),            # in_buf
+        # Cotangents carry the activation dtype (vjp of stage_fn at a
+        # bf16 input yields bf16), so the buffer must match or the
+        # fori_loop carry type check rejects the trace.
+        jnp.zeros(act_shape, x_micro.dtype),            # gin_buf
+        jnp.zeros((K,) + act_shape, x_micro.dtype),     # saved inputs
+        jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+        jnp.zeros((), jnp.float32),
+    )
+    _, _, _, grad_acc, loss_acc = lax.fori_loop(
+        0, M + 2 * S - 2, dtick, carry0)
+
+    # Mean over microbatches; loss broadcast from the last stage.
+    loss = lax.psum(jnp.where(r == S - 1, loss_acc, 0.0), axis_name) / M
+    grads = jax.tree_util.tree_map(lambda g: g / M, grad_acc)
+    return loss, grads

@@ -202,6 +202,51 @@ def test_stall_timeout_strict_mode_raises_stalled_error():
     assert "STALLED after" in outs[0], outs[0]
 
 
+def test_rank_death_mid_ring_propagates_transport_error():
+    """A rank dying while a RING allreduce is in flight must degrade to
+    TransportError on the survivors (bounded by HOROVOD_RING_IO_TIMEOUT +
+    EOF cascade), not an unbounded block on a silent peer socket — the
+    ring-plane analog of the star plane's rank-death guarantee."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import TransportError
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 3, "127.0.0.1", {port})
+        c.collective("allreduce", np.ones(2, np.float32), "warmup")
+        x = np.full(65536, float(rank), np.float32)  # 256 KiB >= threshold
+        if rank == 2:
+            # Announce the ring op so the plan goes out, then die before
+            # (or while) participating in the exchange.
+            c.submit("allreduce", x, "doomed.ring")
+            os._exit(17)
+        try:
+            c.collective("allreduce", x, "doomed.ring")
+            print(f"rank {{rank}}: NO ERROR", flush=True)
+        except TransportError:
+            print(f"rank {{rank}}: TRANSPORT_ERROR", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(3):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu",
+                   HOROVOD_RING_THRESHOLD="65536",
+                   HOROVOD_RING_IO_TIMEOUT="3")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert procs[2].returncode == 17
+    for rank in (0, 1):
+        assert "TRANSPORT_ERROR" in outs[rank], (rank, outs[rank])
+
+
 def test_rank_death_mid_collective_propagates_transport_error():
     """Kill one rank mid-collective: every survivor must get a clean
     TransportError (not a hang) via the coordinated-shutdown-on-client-death
