@@ -22,6 +22,7 @@
 // mutex + condvar; enqueue serializes sends with a socket mutex.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -1416,18 +1417,40 @@ class Client {
       size_t c = addr.rfind(':');
       std::string ip = addr.substr(0, c);
       int pport = atoi(addr.c_str() + c + 1);
-      int attempts = ring_io_secs_ * 1000 / 50;
-      for (int attempt = 0; attempt < attempts; attempt++) {
-        int s = ::socket(AF_INET, SOCK_STREAM, 0);
+      // Wall-clock deadline with NON-BLOCKING connects: a blackholed peer
+      // (SYN dropped, no RST) would otherwise park each blocking connect
+      // on the kernel's ~2 min SYN retry schedule and blow through the
+      // documented HOROVOD_RING_IO_TIMEOUT bound by orders of magnitude.
+      auto cdeadline = std::chrono::steady_clock::now() +
+                       std::chrono::seconds(ring_io_secs_);
+      while (std::chrono::steady_clock::now() < cdeadline) {
+        int s = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
         sockaddr_in a{};
         a.sin_family = AF_INET;
         a.sin_port = htons(static_cast<uint16_t>(pport));
         inet_pton(AF_INET, ip.c_str(), &a.sin_addr);
-        if (::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0) {
+        int rc = ::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
+        bool up = rc == 0;
+        if (!up && errno == EINPROGRESS) {
+          auto left_ms =
+              std::chrono::duration_cast<std::chrono::milliseconds>(
+                  cdeadline - std::chrono::steady_clock::now())
+                  .count();
+          pollfd pfd{s, POLLOUT, 0};
+          if (left_ms > 0 &&
+              ::poll(&pfd, 1, static_cast<int>(left_ms)) > 0) {
+            int soerr = 0;
+            socklen_t slen = sizeof(soerr);
+            getsockopt(s, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+            up = soerr == 0;
+          }
+        }
+        if (up) {
+          // Back to blocking IO with the ring bound on sends.
+          int fl = fcntl(s, F_GETFL, 0);
+          fcntl(s, F_SETFL, fl & ~O_NONBLOCK);
           int one = 1;
           setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-          // Bound every future chunk send: a dead receiver with full TCP
-          // buffers must not block the sender thread forever.
           timeval io_timeout{ring_io_secs_, 0};
           setsockopt(s, SOL_SOCKET, SO_SNDTIMEO, &io_timeout,
                      sizeof(io_timeout));

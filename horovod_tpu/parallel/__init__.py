@@ -9,6 +9,11 @@ all as shard_map-native building blocks over `create_hybrid_mesh`.
 from .mesh import AXES, axis_size, create_hybrid_mesh  # noqa: F401
 from .moe import moe_ffn  # noqa: F401
 from .pipeline import gpipe, one_f_one_b  # noqa: F401
+from .pp_transformer import (  # noqa: F401
+    init_pp_params,
+    make_pp_transformer_train_step,
+    pp_param_specs,
+)
 from .ring import ring_attention, ulysses_attention  # noqa: F401
 from .tp import (  # noqa: F401
     column_parallel,

@@ -51,3 +51,43 @@ def create_hybrid_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sp: int = 1,
 
 def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
+
+
+def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=()):
+    """Gradient sync for spec-sharded parameter trees (runs INSIDE
+    shard_map). One implementation shared by both transformer families —
+    the collective-gradient math is subtle enough that duplicating it is
+    how bugs multiply.
+
+    Each leaf's gradient is averaged (``pmean``) over every mesh axis the
+    leaf is REPLICATED across (all axes not in its own PartitionSpec and
+    not in ``skip_axes`` — e.g. ``pp``, where each stage owns its own
+    weights outright).
+
+    tp-sharded leaves additionally divide by the tp axis size: under
+    full-manual shard_map (check_vma=False) the transpose of the
+    row-parallel ``psum`` is ``psum``, so the replicated cotangent
+    entering each tp-local matmul arrives multiplied by tp — one spurious
+    factor of tp on every tp-sharded weight's gradient (verified
+    empirically: tp=2 vs tp=1 from identical params gave exactly 2x
+    before this correction; replicated leaves are unaffected because
+    their per-rank partials go through the pmean above, and the factor
+    does not compound across layers because partial cotangents are
+    re-summed — not amplified — by the next psum transpose).
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def sync(spec, g):
+        leaf_axes = {ax for s in spec if s
+                     for ax in ((s,) if isinstance(s, str) else s)}
+        over = tuple(a for a in mesh_axes
+                     if a not in leaf_axes and a not in skip_axes)
+        if over:
+            g = lax.pmean(g, over)
+        if "tp" in leaf_axes and "tp" in mesh_axes:
+            g = g / lax.axis_size("tp")
+        return g
+
+    return jax.tree_util.tree_map(sync, specs, grads,
+                                  is_leaf=lambda x: isinstance(x, P))

@@ -75,20 +75,47 @@ def gpipe(stage_fn: Callable, stage_params, x_micro, *,
 
 
 def one_f_one_b(stage_fn: Callable, stage_params, x_micro, y_micro,
-                loss_fn: Callable, *, axis_name: str = "pp"):
+                loss_fn: Callable, *, axis_name: str = "pp",
+                head_params=None, inject_fn: Callable = None,
+                input_grad_acc: Tuple = None,
+                return_input_grads: bool = False):
     """Memory-bounded pipelined TRAINING step (1F1B-style schedule).
 
     Args:
       stage_fn: ``(params, act) -> act`` — one stage's computation.
       stage_params: this rank's stage parameters (any pytree).
       x_micro: [M, mb, ...] microbatched input (stage 0 consumes it).
+        With ``inject_fn``, this can be the RAW input (e.g. token ids) —
+        the per-microbatch activation is produced on demand, so no
+        O(M)-sized activation buffer ever exists.
       y_micro: [M, mb, ...] microbatched labels (last stage consumes it).
-      loss_fn: ``(act, y) -> scalar`` per-microbatch loss, applied to the
-        LAST stage's output.
+      loss_fn: per-microbatch loss applied to the LAST stage's output —
+        ``(act, y) -> scalar``, or ``(act, y, head_params) -> scalar``
+        when ``head_params`` is given (a trainable loss head — e.g. final
+        norm + tied unembedding — living outside the pipeline).
       axis_name: pipeline mesh axis (size S).
+      head_params: optional pytree of loss-head parameters; their
+        gradients are returned (nonzero on the LAST pp rank — psum over
+        pp to share, which also merges them with any input-side
+        contribution to the same replicated tree).
+      inject_fn: optional ``x_micro[i] -> act`` map applied at stage-0
+        injection (an embedding lookup, a vision stem). Differentiation
+        into it goes through ``input_grad_acc`` / ``return_input_grads``
+        cotangents.
+      input_grad_acc: optional ``(acc0, update)`` pair streaming the
+        stage-0 input cotangents into a fixed-size accumulator instead of
+        buffering all M of them: ``update(acc, i, din) -> acc`` is called
+        once per backward microbatch with ``din`` already masked to zeros
+        off pp rank 0 / off schedule (e.g. scatter-add into an embedding
+        gradient). The final ``acc / M`` is returned. Keeps the O(S)
+        memory bound that is the schedule's point.
+      return_input_grads: also return d loss / d (injected input)
+        ([M, mb, ...] activation-sized, nonzero on pp rank 0 — psum over
+        pp to share). Prefer ``input_grad_acc`` when M is large.
 
-    Returns ``(loss, grads)``: the mean loss over microbatches (identical
-    on every pp rank) and this rank's ``stage_params`` gradients of it.
+    Returns ``(loss, grads[, head_grads][, acc][, x_grads])``: the mean
+    loss over microbatches (identical on every pp rank) and this rank's
+    ``stage_params`` gradients of it.
 
     Schedule (global double-tick clock ``d``): stage ``r`` runs forward of
     microbatch ``f = d - r`` and backward of microbatch
@@ -101,19 +128,28 @@ def one_f_one_b(stage_fn: Callable, stage_params, x_micro, y_micro,
     S = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     M = x_micro.shape[0]
-    act_shape = x_micro.shape[1:]
+    if inject_fn is None:
+        inject_fn = lambda x: x  # noqa: E731
+    act_aval = jax.eval_shape(inject_fn, jax.eval_shape(
+        lambda a: a[0], x_micro))
+    act_shape, act_dtype = act_aval.shape, act_aval.dtype
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [((i + 1) % S, i) for i in range(S)]
     K = 2 * S  # saved-input ring depth >= max in-flight (2(S-r))
+    with_head = head_params is not None
+
+    def _head_loss(act, y, head):
+        return loss_fn(act, y, head) if with_head else loss_fn(act, y)
 
     def dtick(d, carry):
-        in_buf, gin_buf, saved, grad_acc, loss_acc = carry
+        (in_buf, gin_buf, saved, grad_acc, head_acc, ig_acc, xg_buf,
+         loss_acc) = carry
 
         # ---- forward of microbatch f = d - r ---------------------------
         f = d - r
         f_valid = jnp.logical_and(f >= 0, f < M)
         fi = jnp.clip(f, 0, M - 1)
-        x_in = jnp.where(r == 0, x_micro[fi], in_buf)
+        x_in = jnp.where(r == 0, inject_fn(x_micro[fi]), in_buf)
         # Remember the input for this microbatch's backward (ring slot).
         saved = saved.at[fi % K].set(
             jnp.where(f_valid, x_in, saved[fi % K]))
@@ -128,34 +164,66 @@ def one_f_one_b(stage_fn: Callable, stage_params, x_micro, y_micro,
         # Cotangent: the last stage differentiates the loss at its
         # (recomputed) output; every other stage uses the grad that
         # arrived from downstream last tick.
-        loss_val, dact = jax.value_and_grad(loss_fn)(primal, y_micro[bi])
+        (loss_val, (dact, dhead)) = jax.value_and_grad(
+            _head_loss, argnums=(0, 2) if with_head else (0,))(
+                primal, y_micro[bi], head_params) \
+            if with_head else _vg_no_head(primal, y_micro[bi])
         ct = jnp.where(r == S - 1, dact.astype(gin_buf.dtype), gin_buf)
         dp, din = vjp(ct)
+        last_b = jnp.logical_and(b_valid, r == S - 1)
         grad_acc = jax.tree_util.tree_map(
             lambda ga, g: ga + jnp.where(b_valid, g, jnp.zeros_like(g)),
             grad_acc, dp)
-        loss_acc = loss_acc + jnp.where(
-            jnp.logical_and(b_valid, r == S - 1), loss_val, 0.0)
+        if with_head:
+            head_acc = jax.tree_util.tree_map(
+                lambda ha, g: ha + jnp.where(last_b, g, jnp.zeros_like(g)),
+                head_acc, dhead)
+        first_b = jnp.logical_and(b_valid, r == 0)
+        if input_grad_acc is not None:
+            din_masked = jnp.where(first_b, din, jnp.zeros_like(din))
+            ig_acc = input_grad_acc[1](ig_acc, bi, din_masked)
+        if return_input_grads:
+            xg_buf = xg_buf.at[bi].set(
+                jnp.where(first_b, din.astype(xg_buf.dtype), xg_buf[bi]))
+        loss_acc = loss_acc + jnp.where(last_b, loss_val, 0.0)
 
         # ---- neighbor exchange (one fwd hop, one bwd hop per tick) -----
         in_buf = lax.ppermute(act, axis_name, fwd_perm)
         gin_buf = lax.ppermute(din, axis_name, bwd_perm)
-        return in_buf, gin_buf, saved, grad_acc, loss_acc
+        return (in_buf, gin_buf, saved, grad_acc, head_acc, ig_acc,
+                xg_buf, loss_acc)
+
+    def _vg_no_head(act, y):
+        loss_val, dact = jax.value_and_grad(loss_fn)(act, y)
+        return loss_val, (dact, None)
 
     carry0 = (
-        jnp.zeros(act_shape, x_micro.dtype),            # in_buf
+        jnp.zeros(act_shape, act_dtype),                # in_buf
         # Cotangents carry the activation dtype (vjp of stage_fn at a
         # bf16 input yields bf16), so the buffer must match or the
         # fori_loop carry type check rejects the trace.
-        jnp.zeros(act_shape, x_micro.dtype),            # gin_buf
-        jnp.zeros((K,) + act_shape, x_micro.dtype),     # saved inputs
+        jnp.zeros(act_shape, act_dtype),                # gin_buf
+        jnp.zeros((K,) + act_shape, act_dtype),         # saved inputs
         jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+        (jax.tree_util.tree_map(jnp.zeros_like, head_params)
+         if with_head else jnp.zeros((), jnp.float32)),
+        (input_grad_acc[0] if input_grad_acc is not None
+         else jnp.zeros((), jnp.float32)),
+        (jnp.zeros((M,) + act_shape, act_dtype)
+         if return_input_grads else jnp.zeros((), jnp.float32)),
         jnp.zeros((), jnp.float32),
     )
-    _, _, _, grad_acc, loss_acc = lax.fori_loop(
-        0, M + 2 * S - 2, dtick, carry0)
+    (_, _, _, grad_acc, head_acc, ig_acc, xg_buf, loss_acc) = \
+        lax.fori_loop(0, M + 2 * S - 2, dtick, carry0)
 
     # Mean over microbatches; loss broadcast from the last stage.
     loss = lax.psum(jnp.where(r == S - 1, loss_acc, 0.0), axis_name) / M
     grads = jax.tree_util.tree_map(lambda g: g / M, grad_acc)
-    return loss, grads
+    out = (loss, grads)
+    if with_head:
+        out = out + (jax.tree_util.tree_map(lambda g: g / M, head_acc),)
+    if input_grad_acc is not None:
+        out = out + (jax.tree_util.tree_map(lambda a: a / M, ig_acc),)
+    if return_input_grads:
+        out = out + (xg_buf / M,)
+    return out

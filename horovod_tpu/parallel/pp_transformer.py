@@ -1,0 +1,220 @@
+"""Pipelined transformer LM: dp × pp × tp composed over one mesh.
+
+Completes the parallelism matrix (the sibling `transformer.py` composes
+dp × sp × tp × ep): transformer layers are partitioned into `pp` stages
+driven by the 1F1B-style memory-bounded schedule
+(:func:`horovod_tpu.parallel.pipeline.one_f_one_b`), with Megatron tensor
+parallelism inside each stage and data parallelism over the batch. One
+compiled SPMD program: `ppermute` stage handoffs, per-layer tp `psum`s and
+the dp gradient `pmean` all ride ICI under XLA's scheduler.
+
+Embedding and the loss head (final RMS norm + tied unembed) live OUTSIDE
+the pipeline so every stage runs the same uniform block structure (the
+lockstep-SPMD requirement): the embedding's gradient is assembled from the
+head's unembed contribution (last pp rank) plus the input-side cotangents
+(pp rank 0) that `one_f_one_b` returns — summed with one `psum` over pp.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.pallas_attention import flash_attention
+from .pipeline import one_f_one_b
+from .transformer import TransformerConfig, _rms_norm
+
+
+def _axes(mesh: Mesh):
+    return set(mesh.axis_names)
+
+
+def init_pp_params(rng, cfg: TransformerConfig, n_stages: int):
+    """Parameters in the pipeline layout: per-layer weights stacked as
+    [n_stages, layers_per_stage, ...]; embed/lnf replicated (the head)."""
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} must divide into "
+                         f"pp={n_stages} stages")
+    lps = cfg.n_layers // n_stages
+    k = jax.random.split(rng, 6)
+    d, f = cfg.d_model, cfg.d_ff
+
+    def norm(key, shape, s):
+        return jax.random.normal(key, shape) * s
+
+    return {
+        "embed": norm(k[0], (cfg.vocab, d), 0.02),
+        "lnf": jnp.ones((d,)),
+        "stages": {
+            "ln1": jnp.ones((n_stages, lps, d)),
+            "wqkv": norm(k[1], (n_stages, lps, d, 3 * d), d ** -0.5),
+            "wo": norm(k[2], (n_stages, lps, d, d), d ** -0.5),
+            "ln2": jnp.ones((n_stages, lps, d)),
+            "w1": norm(k[3], (n_stages, lps, d, f), d ** -0.5),
+            "w2": norm(k[4], (n_stages, lps, f, d), f ** -0.5),
+        },
+    }
+
+
+def pp_param_specs(mesh: Mesh) -> dict:
+    """PartitionSpec tree for :func:`init_pp_params`: stage dim over pp,
+    Megatron column/row sharding over tp, head replicated."""
+    tp = "tp" if "tp" in _axes(mesh) else None
+    return {
+        "embed": P(),
+        "lnf": P(),
+        "stages": {
+            "ln1": P("pp", None, None),
+            "wqkv": P("pp", None, None, tp),   # column: heads over tp
+            "wo": P("pp", None, tp, None),     # row: one psum recombines
+            "ln2": P("pp", None, None),
+            "w1": P("pp", None, None, tp),
+            "w2": P("pp", None, tp, None),
+        },
+    }
+
+
+def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                   optimizer: optax.GradientTransformation,
+                                   n_microbatches: int):
+    """Build ``(init_state, step)`` for the pipelined transformer.
+
+    ``step(params, opt_state, tokens, labels)`` runs one 1F1B update and
+    returns ``(params, opt_state, loss)``; tokens/labels are global
+    [B, T] int32 sharded over dp, with B divisible by
+    dp_size * n_microbatches.
+    """
+    axes = _axes(mesh)
+    if "pp" not in axes:
+        raise ValueError("mesh must have a 'pp' axis")
+    S = mesh.shape["pp"]
+    tp_size = mesh.shape.get("tp", 1)
+    has_tp = "tp" in axes
+    if cfg.n_heads % tp_size:
+        raise ValueError(f"n_heads={cfg.n_heads} must divide tp={tp_size}")
+    n_heads_local = cfg.n_heads // tp_size
+    d_head = cfg.d_model // cfg.n_heads
+    M = n_microbatches
+    specs = pp_param_specs(mesh)
+    batch_spec = P("dp" if "dp" in axes else None, None)
+
+    def _block(layer_i, stage_leaves, x):
+        """One transformer block (pre-norm attention + FFN) from the
+        stage's stacked leaves; tp column/row sharding inside."""
+        g = lambda name: stage_leaves[name][0, layer_i]  # noqa: E731
+        h = _rms_norm(x, g("ln1"))
+        qkv = h @ g("wqkv").astype(cfg.dtype)
+        B, T, _ = qkv.shape
+        # HEAD-major column layout [D, H, 3, dh]: a tp column-slice then
+        # holds whole heads (each with its own q,k,v), so the sharded
+        # model computes the SAME function as tp=1 from the same weights
+        # (checkpoints stay portable across mesh shapes).
+        qkv = qkv.reshape(B, T, n_heads_local, 3, d_head)
+        attn = flash_attention(qkv[..., 0, :], qkv[..., 1, :],
+                               qkv[..., 2, :], causal=True,
+                               backend=cfg.attn_backend).astype(cfg.dtype)
+        proj = attn.reshape(B, T, n_heads_local * d_head) \
+            @ g("wo").astype(cfg.dtype)
+        if has_tp:
+            proj = lax.psum(proj, "tp")
+        x = x + proj
+        h = _rms_norm(x, g("ln2"))
+        up = jax.nn.gelu(h @ g("w1").astype(cfg.dtype))
+        down = up @ g("w2").astype(cfg.dtype)
+        if has_tp:
+            down = lax.psum(down, "tp")
+        return x + down
+
+    lps = cfg.n_layers // S
+
+    def stage_fn(stage_leaves, act):
+        for i in range(lps):
+            act = _block(i, stage_leaves, act)
+        return act
+
+    def head_loss(act, labels, head):
+        h = _rms_norm(act, head["lnf"])
+        logits = jnp.matmul(h.astype(cfg.unembed_dtype),
+                            head["embed"].T.astype(cfg.unembed_dtype),
+                            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.mean(-jnp.take_along_axis(logp, labels[..., None],
+                                             axis=-1))
+
+    def _step(params, opt_state, tokens, labels):
+        B, T = tokens.shape
+        mb = B // M
+        tok_m = tokens.reshape(M, mb, T)
+        y_m = labels.reshape(M, mb, T)
+        head = {"embed": params["embed"], "lnf": params["lnf"]}
+
+        # Tokens (not embeddings) ride the microbatch buffer: inject_fn
+        # embeds per microbatch at stage-0 injection, and the input
+        # cotangents stream straight into a [vocab, D] scatter-add — no
+        # O(M) activation-sized buffer exists, preserving the schedule's
+        # O(S) memory bound end to end.
+        def inject(toks):
+            return params["embed"][toks].astype(cfg.dtype)
+
+        def accumulate_embed_grad(acc, bi, din):
+            return acc.at[tok_m[bi].reshape(-1)].add(
+                din.astype(acc.dtype).reshape(-1, cfg.d_model))
+
+        loss, sg, hg, d_embed_in = one_f_one_b(
+            stage_fn, params["stages"], tok_m, y_m, head_loss,
+            axis_name="pp", head_params=head, inject_fn=inject,
+            input_grad_acc=(jnp.zeros_like(params["embed"]),
+                            accumulate_embed_grad))
+
+        # Embedding gradient = head (unembed) contribution on the last pp
+        # rank + input-lookup contribution on pp rank 0, merged by ONE
+        # psum over pp (zeros elsewhere). lnf rides the same psum.
+        hg = jax.tree_util.tree_map(lambda g: lax.psum(g, "pp"), hg)
+        d_embed = hg["embed"] + lax.psum(d_embed_in, "pp")
+
+        grads = {"embed": d_embed, "lnf": hg["lnf"], "stages": sg}
+
+        # Shared spec-driven sync (see parallel/mesh.py): pmean over each
+        # leaf's replicated axes (never pp — each stage owns its weights)
+        # + the tp psum-transpose correction.
+        from .mesh import grad_sync_by_spec
+        grads = grad_sync_by_spec(grads, specs, axes, skip_axes=("pp",))
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = lax.pmean(loss, tuple(a for a in axes if a != "pp"))
+        return params, opt_state, loss
+
+    ospecs_box = {}
+
+    def init_state(rng):
+        params = init_pp_params(rng, cfg, S)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda x: isinstance(x, P))
+        opt_state = optimizer.init(params)
+        ospecs = optax.tree_map_params(
+            optimizer, lambda _, s: s, opt_state, specs,
+            transform_non_params=lambda _: P())
+        opt_state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x),
+                                        NamedSharding(mesh, s)),
+            opt_state, ospecs, is_leaf=lambda x: isinstance(x, P))
+        ospecs_box["specs"] = ospecs
+        return params, opt_state
+
+    def step(params, opt_state, tokens, labels):
+        if "fn" not in ospecs_box:
+            ospecs_box["fn"] = jax.jit(jax.shard_map(
+                _step, mesh=mesh,
+                in_specs=(specs, ospecs_box["specs"], batch_spec,
+                          batch_spec),
+                out_specs=(specs, ospecs_box["specs"], P()),
+                check_vma=False))
+        return ospecs_box["fn"](params, opt_state, tokens, labels)
+
+    return init_state, step

@@ -143,8 +143,12 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
         h = _rms_norm(x, layer["ln1"])
         qkv = h @ layer["wqkv"].astype(cfg.dtype)     # [B, T, 3·D/tp]
         B, T, _ = qkv.shape
-        qkv = qkv.reshape(B, T, 3, n_heads_local, d_head)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # HEAD-major column layout [D, H, 3, dh]: a tp column-slice holds
+        # whole heads (each with its own q,k,v), so the sharded model
+        # computes the SAME function as tp=1 from the same weights
+        # (checkpoints stay portable across mesh shapes).
+        qkv = qkv.reshape(B, T, n_heads_local, 3, d_head)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         if has_sp:
             attn = ring_attention(q, k, v, axis_name="sp", causal=True)
         else:
@@ -223,16 +227,10 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
     specs = param_specs(cfg, mesh)
 
     def _grad_sync(grads):
-        # Each leaf's gradient is averaged over every mesh axis the leaf is
-        # REPLICATED across (all axes not in its own PartitionSpec): dense
-        # leaves sync over dp/sp/tp/ep, tp-sharded ones over dp/sp/ep, etc.
-        def sync(spec, g):
-            leaf_axes = {ax for s in spec if s
-                         for ax in ((s,) if isinstance(s, str) else s)}
-            over = tuple(a for a in axes if a not in leaf_axes)
-            return lax.pmean(g, over) if over else g
-        return jax.tree_util.tree_map(sync, specs, grads,
-                                      is_leaf=lambda x: isinstance(x, P))
+        # Shared spec-driven sync (see parallel/mesh.py): pmean over each
+        # leaf's replicated axes + the tp psum-transpose correction.
+        from .mesh import grad_sync_by_spec
+        return grad_sync_by_spec(grads, specs, axes)
 
     def _loss_fn(params, tokens, labels):
         logits, aux = forward(params, tokens, cfg, mesh)
