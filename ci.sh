@@ -25,6 +25,7 @@ run_cpu python examples/mnist_advanced.py
 run_cpu python examples/cifar10_cnn.py --epochs 1
 run_cpu python examples/word2vec.py
 run_cpu python examples/transformer_lm.py --dp 2 --sp 2 --tp 2 --steps 12 --seq 64
+run_cpu python examples/transformer_lm.py --dp 2 --pp 2 --tp 2 --steps 12 --seq 64
 run_cpu python examples/imagenet_resnet50.py --epochs 1 --image 32 --batch-per-chip 4 \
   --ckpt-dir "$(mktemp -d)"
 

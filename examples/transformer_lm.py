@@ -1,13 +1,16 @@
-"""Long-context transformer LM over a hybrid dp×sp×tp mesh — the net-new
-capability layer beyond the reference (SURVEY §5.7: the reference predates
-sequence parallelism; this shows ring attention + Megatron sharding + data
+"""Long-context transformer LM over a hybrid mesh — the net-new capability
+layer beyond the reference (SURVEY §5.7: the reference predates sequence
+parallelism; this shows ring attention + Megatron sharding + data/pipeline
 parallelism composing on one device mesh, the "How to Scale Your Model"
 recipe).
 
 Run single-controller (all local chips form the mesh):
     python examples/transformer_lm.py
     python examples/transformer_lm.py --dp 2 --sp 2 --tp 2   # 8 chips
+    python examples/transformer_lm.py --dp 2 --pp 2 --tp 2   # pipelined
 A synthetic copy task (predict the previous token) verifies learning.
+``--pp`` selects the pipelined family (1F1B schedule,
+``parallel/pp_transformer.py``); it composes with dp and tp but not sp/ep.
 """
 
 import argparse
@@ -19,7 +22,8 @@ import optax
 
 import common  # noqa: F401  (sys.path bootstrap)
 from horovod_tpu.parallel import (TransformerConfig, create_hybrid_mesh,
-                                  make_parallel_train_step)
+                                  make_parallel_train_step,
+                                  make_pp_transformer_train_step)
 
 
 def main():
@@ -30,6 +34,11 @@ def main():
                    help="sequence-parallel ways (ring attention)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel ways (Megatron column/row)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (1F1B schedule; "
+                        "composes with dp/tp, not sp)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (--pp > 1)")
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--steps", type=int, default=30)
@@ -37,19 +46,31 @@ def main():
     args = p.parse_args()
 
     n = len(jax.devices())
-    dp = args.dp or max(n // (args.sp * args.tp), 1)
-    if dp * args.sp * args.tp > n:
-        raise SystemExit(f"mesh {dp}x{args.sp}x{args.tp} needs more than "
-                         f"{n} devices")
+    if args.pp > 1 and args.sp > 1:
+        raise SystemExit("--pp composes with dp/tp, not sp")
+    dp = args.dp or max(n // (args.sp * args.tp * args.pp), 1)
+    if dp * args.sp * args.tp * args.pp > n:
+        raise SystemExit(
+            f"mesh {dp}x{args.sp}x{args.tp}x{args.pp} needs more than "
+            f"{n} devices")
 
     cfg = TransformerConfig(vocab=256, d_model=args.d_model, n_heads=8,
-                            n_layers=2, d_ff=4 * args.d_model,
+                            n_layers=2 * max(args.pp, 1),
+                            d_ff=4 * args.d_model,
                             dtype=jnp.bfloat16)
-    mesh = create_hybrid_mesh(dp=dp, sp=args.sp, tp=args.tp)
-    print(f"mesh: dp={dp} sp={args.sp} tp={args.tp} "
-          f"({dp * args.sp * args.tp}/{n} devices), seq={args.seq}")
+    kw = dict(dp=dp, sp=args.sp, tp=args.tp, pp=args.pp)
+    mesh = create_hybrid_mesh(**kw)
+    print(f"mesh: dp={dp} sp={args.sp} tp={args.tp} pp={args.pp} "
+          f"({dp * args.sp * args.tp * args.pp}/{n} devices), "
+          f"seq={args.seq}")
 
-    init_state, step = make_parallel_train_step(cfg, mesh, optax.adam(3e-3))
+    if args.pp > 1:
+        init_state, step = make_pp_transformer_train_step(
+            cfg, mesh, optax.adam(3e-3),
+            n_microbatches=args.microbatches)
+    else:
+        init_state, step = make_parallel_train_step(cfg, mesh,
+                                                    optax.adam(3e-3))
     params, opt_state = init_state(jax.random.PRNGKey(0))
 
     # Synthetic task: predict the PREVIOUS token (causal attention can

@@ -69,6 +69,12 @@ enum class ReqType : uint8_t {
   // independent of world size (vs the star's N·bytes coordinator
   // ingress/egress).
   kAllreduceRing = 5,
+  // Large allgather on the same ring plane: each rank's block circulates
+  // N-1 hops, so per-rank traffic is ~(output - own block) — the star
+  // would push N x output through the coordinator's egress. Ragged first
+  // dims ride the same negotiated sizes the star allgather uses (the
+  // reference's MPI_Allgatherv ring, mpi_ops.cc:788-808).
+  kAllgatherRing = 6,
 };
 enum class RespType : uint8_t {
   kAllreduce = 0,
@@ -79,6 +85,12 @@ enum class RespType : uint8_t {
   kAlltoall = 5,
   kReducescatter = 6,
   kAllreduceRing = 7,  // carries the ring plan (peer addresses), no payload
+  kAllgatherRing = 8,  // ring plan + negotiated per-rank first dims
+  // Ragged allgathers can legitimately STRADDLE the ring threshold (some
+  // ranks' blocks above it, some below — no config skew involved). The
+  // coordinator resolves the mix by asking the ring announcers to
+  // resubmit with their payload (one extra round trip, mixed case only).
+  kResubmitStar = 9,
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -125,10 +137,11 @@ const char* ReqTypeName(ReqType t) {
     case ReqType::kBroadcast: return "BROADCAST";
     case ReqType::kAlltoall: return "ALLTOALL";
     case ReqType::kReducescatter: return "REDUCESCATTER";
-    // Distinct name so a mixed star/ring announcement (skewed
+    // Distinct names so a mixed star/ring announcement (skewed
     // HOROVOD_RING_THRESHOLD across ranks) produces a self-explaining
     // mismatch error.
     case ReqType::kAllreduceRing: return "ALLREDUCE_RING";
+    case ReqType::kAllgatherRing: return "ALLGATHER_RING";
   }
   return "UNKNOWN";
 }
@@ -781,11 +794,44 @@ class Coordinator {
   // bucketing); this is the host eager plane's, fed by the async API's
   // in-flight concurrency (reference: ComputeAsync kernels,
   // mpi_ops.cc:1752-1772).
+  // A fully-announced allgather may mix ALLGATHER (payload shipped) and
+  // ALLGATHER_RING (payload held back) when per-rank block sizes straddle
+  // HOROVOD_RING_THRESHOLD — a legitimate ragged input, not config skew.
+  // Resolve by demoting: tell the ring announcers to resubmit as star,
+  // un-count them, and keep the op pending until the payloads arrive.
+  bool DemoteMixedGatherRing(const std::string& name, PendingTensor* p) {
+    bool star = false, ring = false;
+    for (auto& r : p->requests) {
+      star = star || r.type == ReqType::kAllgather;
+      ring = ring || r.type == ReqType::kAllgatherRing;
+    }
+    if (!star || !ring) return false;
+    Response resp;
+    resp.type = RespType::kResubmitStar;
+    resp.name = name;
+    std::string body = EncodeResponse(resp);
+    for (auto it = p->requests.begin(); it != p->requests.end();) {
+      if (it->type == ReqType::kAllgatherRing) {
+        SendFrame(client_fds_[it->rank], send_mu_, body);
+        p->announced[it->rank] = false;
+        p->count--;
+        it = p->requests.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    return true;
+  }
+
   void DrainReady() {
     std::vector<std::string> ready;
     for (auto it = arrival_order_.begin(); it != arrival_order_.end();) {
       auto t = table_.find(*it);
       if (t != table_.end() && t->second.count == size_) {
+        if (DemoteMixedGatherRing(*it, &t->second)) {
+          ++it;  // stays pending until the star resubmissions land
+          continue;
+        }
         ready.push_back(*it);
         it = arrival_order_.erase(it);
       } else {
@@ -906,7 +952,7 @@ class Coordinator {
       }
     }
 
-    if (op == ReqType::kAllgather) {
+    if (op == ReqType::kAllgather || op == ReqType::kAllgatherRing) {
       const auto& shape0 = requests[0].shape;
       if (shape0.empty()) {
         err << "Rank zero tried to ALLGATHER a rank-zero tensor.";
@@ -989,6 +1035,7 @@ class Coordinator {
       case ReqType::kAlltoall: act = "ALLTOALL"; break;
       case ReqType::kReducescatter: act = "REDUCESCATTER"; break;
       case ReqType::kAllreduceRing: act = "RING_PLAN"; break;
+      case ReqType::kAllgatherRing: act = "RING_PLAN"; break;
     }
     if (timeline_.enabled()) {
       timeline_.Start(resp.name, ReqTypeName(op));  // top-level Start
@@ -1039,6 +1086,14 @@ class Coordinator {
         // No host execution: ship the ring plan; clients move the data
         // among themselves (reduce-scatter + allgather over the rank ring).
         resp.type = RespType::kAllreduceRing;
+        resp.shape = requests[0].shape;
+        resp.ring_peers = peer_addrs_;
+        break;
+      }
+      case ReqType::kAllgatherRing: {
+        // resp.sizes (per-rank first dims) was filled by the allgather
+        // validation above; clients circulate their blocks themselves.
+        resp.type = RespType::kAllgatherRing;
         resp.shape = requests[0].shape;
         resp.ring_peers = peer_addrs_;
         break;
@@ -1339,20 +1394,26 @@ class Client {
     return SendFrame(fd_, send_mu_, EncodeRequest(req));
   }
 
-  // Enqueue with ring election: a large allreduce is announced WITHOUT its
-  // payload (kAllreduceRing); the bytes stay here until the coordinator's
-  // ring plan arrives, then move client-to-client. Everything else takes
-  // the star.
+  // Enqueue with ring election: a large allreduce/allgather is announced
+  // WITHOUT its payload (kAllreduceRing/kAllgatherRing); the bytes stay
+  // here until the coordinator's ring plan arrives, then move
+  // client-to-client. Everything else takes the star.
   bool Submit(Request req) {
-    if (req.type == ReqType::kAllreduce && size_ > 1 &&
-        ring_threshold_ > 0 && peer_listen_fd_ >= 0 &&
-        static_cast<int64_t>(req.payload.size()) >= ring_threshold_) {
+    bool ringable =
+        (req.type == ReqType::kAllreduce ||
+         req.type == ReqType::kAllgather) &&
+        size_ > 1 && ring_threshold_ > 0 && peer_listen_fd_ >= 0 &&
+        static_cast<int64_t>(req.payload.size()) >= ring_threshold_;
+    if (ringable) {
       {
         std::lock_guard<std::mutex> l(ring_mu_);
-        ring_pending_[req.name] =
-            RingWork{std::move(req.payload), req.dtype, req.red_op};
+        ring_pending_[req.name] = RingWork{std::move(req.payload),
+                                           req.dtype, req.red_op,
+                                           req.shape};
       }
-      req.type = ReqType::kAllreduceRing;
+      req.type = req.type == ReqType::kAllreduce
+                     ? ReqType::kAllreduceRing
+                     : ReqType::kAllgatherRing;
       req.payload.clear();
       if (!Enqueue(req)) {
         std::lock_guard<std::mutex> l(ring_mu_);
@@ -1403,6 +1464,7 @@ class Client {
     std::string payload;
     DType dtype;
     RedOp red_op;
+    std::vector<int64_t> shape;  // own announced shape (row size for ragged)
   };
 
   bool EnsurePeers(const std::vector<std::string>& peers) {
@@ -1577,6 +1639,36 @@ class Client {
     return true;
   }
 
+  // Ring allgather: each rank's (possibly ragged) block circulates N-1
+  // hops; at step s we forward the block received at step s-1 while
+  // writing the incoming one straight into its slot of the final
+  // rank-ordered concatenation. Per-rank traffic = output - own block.
+  bool RunRingGather(const Response& plan, RingWork work,
+                     std::string* out) {
+    if (!EnsurePeers(plan.ring_peers)) return false;
+    const int N = size_;
+    int64_t row_bytes = static_cast<int64_t>(DTypeSize(work.dtype));
+    for (size_t i = 1; i < work.shape.size(); i++)
+      row_bytes *= work.shape[i];
+    std::vector<int64_t> nb(N), off(N + 1, 0);
+    for (int i = 0; i < N; i++) {
+      nb[i] = plan.sizes[i] * row_bytes;
+      off[i + 1] = off[i] + nb[i];
+    }
+    out->assign(static_cast<size_t>(off[N]), '\0');
+    memcpy(&(*out)[0] + off[rank_], work.payload.data(),
+           work.payload.size());
+    for (int s = 0; s <= N - 2; s++) {
+      int snd = (rank_ - s + N) % N;
+      int rcv = (rank_ - s - 1 + N) % N;
+      if (!RingStep(out->data() + off[snd], static_cast<size_t>(nb[snd]),
+                    &(*out)[0] + off[rcv], static_cast<size_t>(nb[rcv])))
+        return false;
+    }
+    ring_ops_++;
+    return true;
+  }
+
   void RecvLoop() {
     while (!shutdown_.load()) {
       std::string body;
@@ -1586,7 +1678,42 @@ class Client {
       if (tag != MsgTag::kResponse) break;
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
-      if (resp.type == RespType::kAllreduceRing) {
+      if (resp.type == RespType::kResubmitStar) {
+        // Mixed straddling-threshold allgather: re-announce with the
+        // stashed payload over the star plane.
+        RingWork work;
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it == ring_pending_.end()) break;  // protocol violation
+          work = std::move(it->second);
+          ring_pending_.erase(it);
+        }
+        Request rq;
+        rq.rank = rank_;
+        rq.type = ReqType::kAllgather;
+        rq.dtype = work.dtype;
+        rq.red_op = work.red_op;
+        rq.shape = work.shape;
+        rq.name = resp.name;
+        rq.payload = std::move(work.payload);
+        if (!Enqueue(rq)) break;
+        continue;
+      }
+      if (resp.type == RespType::kAllgatherRing) {
+        RingWork work;
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it == ring_pending_.end()) break;  // protocol violation
+          work = std::move(it->second);
+          ring_pending_.erase(it);
+        }
+        std::string gathered;
+        if (!RunRingGather(resp, std::move(work), &gathered)) break;
+        resp.type = RespType::kAllgather;  // sizes already negotiated
+        resp.payload = std::move(gathered);
+      } else if (resp.type == RespType::kAllreduceRing) {
         // NB: a ring op whose wait stall-timed-out keeps its stash here
         // until the plan (or an error) arrives — if the slow ranks do
         // announce late, the world still needs this rank's payload to

@@ -159,10 +159,52 @@ void RankMain(int rank, std::atomic<int>* failures) {
       }
     }
   }
-  if (client.ring_ops() != 3) failures->fetch_add(1);
-  // Bandwidth optimality: each ring op moves 2*(N-1)/N * payload per rank
-  // (up to one element of chunk-remainder skew per send).
-  long long expect = 3LL * 2 * (kSize - 1) * 4000 / kSize;
+  // Ring allgather round: RAGGED first dims (rank r contributes r+1
+  // rows of 4 floats) circulate the ring; result must be the rank-order
+  // concatenation.
+  {
+    int rows = (rank + 1) * 4;  // 64..192 B blocks: all above threshold
+    std::vector<float> v(rows * 4);
+    for (int i = 0; i < rows * 4; i++) v[i] = rank * 1000.f + i;
+    Request req;
+    req.rank = rank;
+    req.type = ReqType::kAllgather;
+    req.dtype = DType::kF32;
+    req.shape = {rows, 4};
+    req.name = "ring.gather";
+    req.payload = F32Payload(v);
+    if (!client.Submit(std::move(req))) failures->fetch_add(1);
+    Response resp;
+    if (client.Wait("ring.gather", &resp) != 0 ||
+        resp.type != hvdcoord::RespType::kAllgather) {
+      failures->fetch_add(1);
+    } else {
+      size_t total_elems = 0;
+      for (int r2 = 0; r2 < kSize; r2++) total_elems += (r2 + 1) * 16;
+      if (resp.payload.size() != total_elems * 4) {
+        failures->fetch_add(1);
+      } else {
+        const float* out =
+            reinterpret_cast<const float*>(resp.payload.data());
+        size_t offset = 0;
+        bool ok = true;
+        for (int r2 = 0; r2 < kSize; r2++) {
+          for (int i = 0; i < (r2 + 1) * 16; i++)
+            ok = ok &&
+                 std::fabs(out[offset + i] - (r2 * 1000.f + i)) < 1e-6;
+          offset += (r2 + 1) * 16;
+        }
+        if (!ok) failures->fetch_add(1);
+      }
+    }
+  }
+  if (client.ring_ops() != 4) failures->fetch_add(1);
+  // Bandwidth optimality: each ring allreduce moves 2*(N-1)/N * payload
+  // per rank (up to one element of chunk-remainder skew per send); the
+  // gather round sends exactly its two forwarded blocks.
+  long long expect = 3LL * 2 * (kSize - 1) * 4000 / kSize +
+                     64LL * (rank + 1) +
+                     64LL * (((rank - 1 + kSize) % kSize) + 1);
   long long sent = client.ring_bytes_sent();
   if (sent < expect - 64 || sent > expect + 64) {
     fprintf(stderr, "rank %d: ring bytes %lld !~ %lld\n", rank, sent,

@@ -20,7 +20,7 @@ into S stages, one per pp rank; activations flow stage-to-stage with
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -77,7 +77,7 @@ def gpipe(stage_fn: Callable, stage_params, x_micro, *,
 def one_f_one_b(stage_fn: Callable, stage_params, x_micro, y_micro,
                 loss_fn: Callable, *, axis_name: str = "pp",
                 head_params=None, inject_fn: Callable = None,
-                input_grad_acc: Tuple = None,
+                input_grad_acc: Optional[Tuple] = None,
                 return_input_grads: bool = False):
     """Memory-bounded pipelined TRAINING step (1F1B-style schedule).
 

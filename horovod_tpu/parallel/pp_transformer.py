@@ -17,8 +17,6 @@ head's unembed contribution (last pp rank) plus the input-side cotangents
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import optax
@@ -189,7 +187,13 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         loss = lax.pmean(loss, tuple(a for a in axes if a != "pp"))
         return params, opt_state, loss
 
-    ospecs_box = {}
+    def _opt_specs(opt_state):
+        # Derivable from any opt_state with the right STRUCTURE, so the
+        # checkpoint-restore path (params/opt_state from disk, init_state
+        # never called) works too.
+        return optax.tree_map_params(
+            optimizer, lambda _, s: s, opt_state, specs,
+            transform_non_params=lambda _: P())
 
     def init_state(rng):
         params = init_pp_params(rng, cfg, S)
@@ -197,24 +201,23 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, specs, is_leaf=lambda x: isinstance(x, P))
         opt_state = optimizer.init(params)
-        ospecs = optax.tree_map_params(
-            optimizer, lambda _, s: s, opt_state, specs,
-            transform_non_params=lambda _: P())
         opt_state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x),
                                         NamedSharding(mesh, s)),
-            opt_state, ospecs, is_leaf=lambda x: isinstance(x, P))
-        ospecs_box["specs"] = ospecs
+            opt_state, _opt_specs(opt_state),
+            is_leaf=lambda x: isinstance(x, P))
         return params, opt_state
 
+    fn_box = {}
+
     def step(params, opt_state, tokens, labels):
-        if "fn" not in ospecs_box:
-            ospecs_box["fn"] = jax.jit(jax.shard_map(
+        if "fn" not in fn_box:
+            ospecs = _opt_specs(opt_state)
+            fn_box["fn"] = jax.jit(jax.shard_map(
                 _step, mesh=mesh,
-                in_specs=(specs, ospecs_box["specs"], batch_spec,
-                          batch_spec),
-                out_specs=(specs, ospecs_box["specs"], P()),
+                in_specs=(specs, ospecs, batch_spec, batch_spec),
+                out_specs=(specs, ospecs, P()),
                 check_vma=False))
-        return ospecs_box["fn"](params, opt_state, tokens, labels)
+        return fn_box["fn"](params, opt_state, tokens, labels)
 
     return init_state, step

@@ -248,37 +248,38 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
         return params, opt_state, loss
 
     pspecs = specs
-    ospecs_template = None
+
+    def _opt_specs(opt_state):
+        # Derivable from any opt_state with the right STRUCTURE, so the
+        # checkpoint-restore path (params/opt_state from disk, init_state
+        # never called) works too.
+        return optax.tree_map_params(
+            optimizer, lambda _, s: s, opt_state, pspecs,
+            transform_non_params=lambda _: P())
 
     def init_state(rng):
-        nonlocal ospecs_template
         params = init_params(rng, cfg)
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, pspecs, is_leaf=lambda x: isinstance(x, P))
         opt_state = optimizer.init(params)
-        ospecs_template = optax.tree_map_params(
-            optimizer, lambda _, s: s, opt_state, pspecs,
-            transform_non_params=lambda _: P())
         opt_state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x),
                                         NamedSharding(mesh, s)),
-            opt_state, ospecs_template,
+            opt_state, _opt_specs(opt_state),
             is_leaf=lambda x: isinstance(x, P))
         return params, opt_state
-
-    def make_jitted():
-        return jax.jit(jax.shard_map(
-            _step, mesh=mesh,
-            in_specs=(pspecs, ospecs_template, batch_spec, batch_spec),
-            out_specs=(pspecs, ospecs_template, P()),
-            check_vma=False))
 
     jitted = {}
 
     def step(params, opt_state, tokens, labels):
         if "fn" not in jitted:
-            jitted["fn"] = make_jitted()
+            ospecs = _opt_specs(opt_state)
+            jitted["fn"] = jax.jit(jax.shard_map(
+                _step, mesh=mesh,
+                in_specs=(pspecs, ospecs, batch_spec, batch_spec),
+                out_specs=(pspecs, ospecs, P()),
+                check_vma=False))
         return jitted["fn"](params, opt_state, tokens, labels)
 
     return init_state, step

@@ -109,6 +109,106 @@ def test_ring_allreduce_large_payload_bandwidth_optimal():
         assert f"rank {rank}: RING_OK" in out
 
 
+def test_ring_allgather_ragged_large_payload():
+    """Large allgathers (the sparse/embedding gradient path) ride the ring
+    too: RAGGED per-rank first dims circulate client-to-client — per-rank
+    sent bytes = its two forwarded blocks per hop, total = output minus
+    own block — while the star would push N x output through the
+    coordinator. Result equals the star plane's rank-order concat."""
+    import textwrap
+    size = 4
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, {size}, "127.0.0.1", {port})
+        rows = 1024 * (rank + 1)          # ragged: 1k..4k rows of 64 f32
+        x = (np.arange(rows * 64, dtype=np.float32).reshape(rows, 64)
+             + rank * 1e6)
+        out = np.asarray(c.collective("allgather", x, "big.gather"))
+        total = 1024 * (1 + 2 + 3 + 4)
+        assert out.shape == (total, 64), out.shape
+        off = 0
+        for r2 in range({size}):
+            rr = 1024 * (r2 + 1)
+            expect = (np.arange(rr * 64, dtype=np.float32)
+                      .reshape(rr, 64) + r2 * 1e6)
+            assert np.array_equal(out[off:off + rr], expect), r2
+            off += rr
+        assert c.ring_ops() == 1, c.ring_ops()
+        # Sent = the two blocks this rank forwards per hop, summed over
+        # N-1 hops = total output minus its own block.
+        row_b = 64 * 4
+        nb = [1024 * (r2 + 1) * row_b for r2 in range({size})]
+        sent_expect = sum(nb[(rank - s) % {size}]
+                          for s in range({size} - 1))
+        assert c.ring_bytes_sent() == sent_expect, (
+            c.ring_bytes_sent(), sent_expect)
+        print(f"rank {{rank}}: GATHER_RING_OK", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu", HOROVOD_RING_THRESHOLD="262144")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: GATHER_RING_OK" in out
+
+
+def test_ring_allgather_straddling_threshold_falls_back_to_star():
+    """Ragged blocks that STRADDLE the ring threshold (legitimately — no
+    config skew) mix ALLGATHER and ALLGATHER_RING announcements; the
+    coordinator must resolve the mix by asking ring announcers to
+    resubmit with payload (one extra round), not error out."""
+    import textwrap
+    size = 3
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, {size}, "127.0.0.1", {port})
+        rows = 4 * (rank + 1)   # 128 B / 256 B / 384 B vs threshold 200
+        x = (np.arange(rows * 8, dtype=np.float32).reshape(rows, 8)
+             + rank * 1e4)
+        out = np.asarray(c.collective("allgather", x, "straddle"))
+        assert out.shape == (4 + 8 + 12, 8), out.shape
+        off = 0
+        for r2 in range({size}):
+            rr = 4 * (r2 + 1)
+            expect = (np.arange(rr * 8, dtype=np.float32).reshape(rr, 8)
+                      + r2 * 1e4)
+            assert np.array_equal(out[off:off + rr], expect), r2
+            off += rr
+        assert c.ring_ops() == 0, c.ring_ops()  # resolved over the star
+        print(f"rank {{rank}}: STRADDLE_OK", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu", HOROVOD_RING_THRESHOLD="200")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: STRADDLE_OK" in out
+
+
 def test_ring_threshold_skew_is_a_named_validation_error():
     """If HOROVOD_RING_THRESHOLD disagrees across ranks the same tensor is
     announced ALLREDUCE_RING on one rank and ALLREDUCE on another — that
