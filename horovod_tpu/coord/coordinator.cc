@@ -75,6 +75,8 @@ enum class ReqType : uint8_t {
   // dims ride the same negotiated sizes the star allgather uses (the
   // reference's MPI_Allgatherv ring, mpi_ops.cc:788-808).
   kAllgatherRing = 6,
+  // Large broadcast (root-elected): chunk-pipelined chain from the root.
+  kBroadcastRing = 7,
 };
 enum class RespType : uint8_t {
   kAllreduce = 0,
@@ -91,6 +93,12 @@ enum class RespType : uint8_t {
   // coordinator resolves the mix by asking the ring announcers to
   // resubmit with their payload (one extra round trip, mixed case only).
   kResubmitStar = 9,
+  // Large broadcast over the ring as a chunk-pipelined CHAIN from the
+  // root: per-link traffic is exactly the payload (the star's
+  // coordinator egress is N x payload) — the bandwidth model inside
+  // MPI_Bcast (mpi_ops.cc:1134-1136). Only the ROOT elects (it alone
+  // ships payload); non-roots follow the plan.
+  kBroadcastRing = 10,
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -142,6 +150,7 @@ const char* ReqTypeName(ReqType t) {
     // mismatch error.
     case ReqType::kAllreduceRing: return "ALLREDUCE_RING";
     case ReqType::kAllgatherRing: return "ALLGATHER_RING";
+    case ReqType::kBroadcastRing: return "BROADCAST_RING";
   }
   return "UNKNOWN";
 }
@@ -172,7 +181,7 @@ enum class MsgTag : uint8_t {
 // different builds — exactly the cross-rank config skew init must reject
 // (the analog of the reference's per-tensor placement validation,
 // mpi_ops.cc:439-449, moved to init time where TPU worlds can check it).
-constexpr int32_t kProtocolVersion = 3;
+constexpr int32_t kProtocolVersion = 4;
 
 struct Request {
   int32_t rank = -1;
@@ -200,7 +209,8 @@ struct Response {
   // Ring plan (kAllreduceRing): "ip:port" peer data-plane addresses indexed
   // by rank; clients run the chunked ring among themselves.
   std::vector<std::string> ring_peers;
-  // Coordinator-local bookkeeping (never on the wire).
+  // dtype: on the wire for ring plans (sizes non-root broadcast
+  // buffers); otherwise coordinator-local bookkeeping.
   DType dtype = DType::kF32;
   std::vector<int64_t> shape;                 // output shape (timeline args)
   std::vector<std::string> per_rank_payloads; // alltoall/reducescatter
@@ -286,6 +296,9 @@ std::string EncodeResponse(const Response& r) {
   }
   b.PutI32(static_cast<int32_t>(r.ring_peers.size()));
   for (const auto& p : r.ring_peers) b.PutStr(p);
+  // dtype rides the wire for ring PLANS: a non-root broadcast client has
+  // no stash, so the plan itself must size the receive buffer.
+  b.PutU8(static_cast<uint8_t>(r.dtype));
   b.PutStr(r.payload);
   return b.str();
 }
@@ -304,6 +317,7 @@ Response DecodeResponse(Reader& rd) {
   }
   int np = rd.GetI32();
   for (int i = 0; i < np; i++) r.ring_peers.push_back(rd.GetStr());
+  r.dtype = static_cast<DType>(rd.GetU8());
   r.payload = rd.GetStr();
   return r;
 }
@@ -907,6 +921,32 @@ class Coordinator {
         return resp;
       }
     }
+    // Broadcast family: only the ROOT ships payload, so only its
+    // election decides star vs ring; non-roots always announce plain
+    // BROADCAST. Normalize before the mismatch check (a ring
+    // announcement from a NON-root is left un-normalized and caught as
+    // a genuine mismatch below).
+    bool bcast_ring = false;
+    {
+      bool family = true;
+      for (auto& r : requests)
+        family = family && (r.type == ReqType::kBroadcast ||
+                            r.type == ReqType::kBroadcastRing);
+      if (family) {
+        bool roots_only = true;
+        bool any_ring = false;
+        for (auto& r : requests)
+          if (r.type == ReqType::kBroadcastRing) {
+            any_ring = true;
+            roots_only = roots_only && r.rank == r.root_rank;
+          }
+        if (any_ring && roots_only) {
+          bcast_ring = true;
+          for (auto& r : requests) r.type = ReqType::kBroadcast;
+        }
+      }
+    }
+
     ReqType op = requests[0].type;
     for (auto& r : requests) {
       if (r.type != op) {
@@ -1036,6 +1076,7 @@ class Coordinator {
       case ReqType::kReducescatter: act = "REDUCESCATTER"; break;
       case ReqType::kAllreduceRing: act = "RING_PLAN"; break;
       case ReqType::kAllgatherRing: act = "RING_PLAN"; break;
+      case ReqType::kBroadcastRing: act = "RING_PLAN"; break;
     }
     if (timeline_.enabled()) {
       timeline_.Start(resp.name, ReqTypeName(op));  // top-level Start
@@ -1062,6 +1103,15 @@ class Coordinator {
         break;
       }
       case ReqType::kBroadcast: {
+        if (bcast_ring) {
+          // Chain plan: no payload through the coordinator; sizes[0]
+          // carries the root for the clients' chain orientation.
+          resp.type = RespType::kBroadcastRing;
+          resp.shape = requests[0].shape;
+          resp.sizes = {requests[0].root_rank};
+          resp.ring_peers = peer_addrs_;
+          break;
+        }
         resp.type = RespType::kBroadcast;
         resp.shape = requests[0].shape;
         resp.payload = requests[requests[0].root_rank].payload;
@@ -1401,7 +1451,8 @@ class Client {
   bool Submit(Request req) {
     bool ringable =
         (req.type == ReqType::kAllreduce ||
-         req.type == ReqType::kAllgather) &&
+         req.type == ReqType::kAllgather ||
+         (req.type == ReqType::kBroadcast && req.root_rank == rank_)) &&
         size_ > 1 && ring_threshold_ > 0 && peer_listen_fd_ >= 0 &&
         static_cast<int64_t>(req.payload.size()) >= ring_threshold_;
     if (ringable) {
@@ -1413,7 +1464,9 @@ class Client {
       }
       req.type = req.type == ReqType::kAllreduce
                      ? ReqType::kAllreduceRing
-                     : ReqType::kAllgatherRing;
+                     : (req.type == ReqType::kAllgather
+                            ? ReqType::kAllgatherRing
+                            : ReqType::kBroadcastRing);
       req.payload.clear();
       if (!Enqueue(req)) {
         std::lock_guard<std::mutex> l(ring_mu_);
@@ -1669,6 +1722,45 @@ class Client {
     return true;
   }
 
+  // Ring broadcast: chunk-pipelined CHAIN from the root around the rank
+  // ring (root -> root+1 -> ... -> root-1). Middle ranks forward chunk
+  // c-1 while receiving chunk c (RingStep's simultaneous send+recv), so
+  // the payload streams down the chain at link bandwidth; per-link bytes
+  // = payload exactly.
+  bool RunRingBcast(const Response& plan, std::string root_payload,
+                    std::string* out) {
+    if (!EnsurePeers(plan.ring_peers)) return false;
+    int root = static_cast<int>(plan.sizes.empty() ? 0 : plan.sizes[0]);
+    int64_t total = DTypeSize(plan.dtype);
+    for (int64_t d : plan.shape) total *= d;
+    const size_t kChunk = 1 << 20;
+    bool is_last = rank_ == (root - 1 + size_) % size_;
+    if (rank_ == root) {
+      *out = std::move(root_payload);
+      for (size_t o = 0; o < static_cast<size_t>(total); o += kChunk) {
+        size_t l = std::min(kChunk, static_cast<size_t>(total) - o);
+        if (!RingStep(out->data() + o, l, nullptr, 0)) return false;
+      }
+    } else {
+      out->assign(static_cast<size_t>(total), '\0');
+      size_t po = 0, pl = 0;
+      for (size_t o = 0; o < static_cast<size_t>(total); o += kChunk) {
+        size_t l = std::min(kChunk, static_cast<size_t>(total) - o);
+        // Forward the previous chunk while receiving this one.
+        if (!RingStep(is_last ? nullptr : out->data() + po,
+                      is_last ? 0 : pl, &(*out)[0] + o, l))
+          return false;
+        po = o;
+        pl = l;
+      }
+      if (!is_last && pl > 0) {
+        if (!RingStep(out->data() + po, pl, nullptr, 0)) return false;
+      }
+    }
+    ring_ops_++;
+    return true;
+  }
+
   void RecvLoop() {
     while (!shutdown_.load()) {
       std::string body;
@@ -1700,7 +1792,22 @@ class Client {
         if (!Enqueue(rq)) break;
         continue;
       }
-      if (resp.type == RespType::kAllgatherRing) {
+      if (resp.type == RespType::kBroadcastRing) {
+        std::string stash;  // only the root has one
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it != ring_pending_.end()) {
+            stash = std::move(it->second.payload);
+            ring_pending_.erase(it);
+          }
+        }
+        std::string result;
+        if (!RunRingBcast(resp, std::move(stash), &result)) break;
+        resp.type = RespType::kBroadcast;
+        resp.payload = std::move(result);
+        resp.sizes.clear();
+      } else if (resp.type == RespType::kAllgatherRing) {
         RingWork work;
         {
           std::lock_guard<std::mutex> l(ring_mu_);

@@ -418,10 +418,10 @@ def test_stray_client_does_not_kill_coordinator():
         # Out-of-range rank, duplicate rank, wrong world size, wrong
         # protocol version, a stale 12-byte v2 hello, and a junk frame —
         # each must be rejected with a hello-ack naming the reason, without
-        # hurting the real world. (v3 hello: rank, size, version, peer_port)
-        hellos = (struct.pack("<iiii", 99, 2, 3, 0),  # out-of-range rank
-                  struct.pack("<iiii", 0, 2, 3, 0),   # duplicate rank 0
-                  struct.pack("<iiii", 1, 5, 3, 0),   # world-size mismatch
+        # hurting the real world. (v4 hello: rank, size, version, peer_port)
+        hellos = (struct.pack("<iiii", 99, 2, 4, 0),  # out-of-range rank
+                  struct.pack("<iiii", 0, 2, 4, 0),   # duplicate rank 0
+                  struct.pack("<iiii", 1, 5, 4, 0),   # world-size mismatch
                   struct.pack("<iiii", 1, 2, 99, 0),  # protocol mismatch
                   struct.pack("<iii", 1, 2, 2),       # old-build 12B hello
                   b"xx")                              # junk
