@@ -70,7 +70,8 @@ def _build_and_load() -> ctypes.CDLL:
     lib.hvdcoord_submit.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
-        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int]
     lib.hvdcoord_wait.restype = ctypes.c_int
     lib.hvdcoord_wait.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
@@ -132,7 +133,8 @@ class CoordClient:
                    timeline=timeline)
 
     # -- eager collectives -------------------------------------------------
-    def collective(self, kind: str, x, name: str, *, op=None, root_rank=0):
+    def collective(self, kind: str, x, name: str, *, op=None, root_rank=0,
+                   plane: str = "auto"):
         """Run one named eager collective through the host plane.
 
         Semantics parity: eager ``hvd.allreduce/allgather/broadcast(value)``
@@ -140,14 +142,22 @@ class CoordClient:
         FailedPreconditionError (``mpi_ops.cc:1141-1148``).
         """
         return self.wait(self.submit(kind, x, name, op=op,
-                                     root_rank=root_rank))
+                                     root_rank=root_rank, plane=plane))
 
     def submit(self, kind: str, x, name: str, *, op=None,
-               root_rank=0) -> "CoordHandle":
+               root_rank=0, plane: str = "auto") -> "CoordHandle":
         """Non-blocking announce+send (the reference's ``ComputeAsync`` +
         ``EnqueueTensor*`` model, ``mpi_ops.cc:1752-1772``): many submits can
         be in flight at once, which is what feeds coordinator-side response
-        fusion. Complete with :meth:`wait`."""
+        fusion. Complete with :meth:`wait`.
+
+        ``plane`` is the per-call placement override, the analog of the
+        reference's per-call ``device_dense=``/``device_sparse=`` knobs
+        (``horovod/tensorflow/__init__.py:43-55``): ``"auto"`` lets
+        ``HOROVOD_RING_THRESHOLD`` elect, ``"star"`` forces the coordinator
+        star, ``"ring"`` forces the client-to-client peer plane (must agree
+        across ranks; a non-root broadcast always announces star — the root
+        alone elects the plane)."""
         from ..ops.collectives import Op
 
         arr = np.asarray(x)
@@ -180,6 +190,11 @@ class CoordClient:
                 f"{self.rank}; synchronize() the first handle before "
                 f"reusing the name (or pass name=None for auto-naming)")
 
+        planes = {"auto": 0, "star": 1, "ring": 2}
+        if plane not in planes:
+            raise ValueError(f"plane must be one of {sorted(planes)}, "
+                             f"got {plane!r}")
+
         send_payload = not (kind == "broadcast" and self.rank != root_rank)
         data = np.ascontiguousarray(arr) if send_payload else None
 
@@ -189,7 +204,8 @@ class CoordClient:
             name.encode(), _REQ_TYPES[kind], _DTYPES[dtype_name], red_op,
             root_rank, arr.ndim, shape,
             data.ctypes.data if data is not None else None,
-            data.nbytes if data is not None else 0, err, len(err))
+            data.nbytes if data is not None else 0, planes[plane],
+            err, len(err))
         if rc != 0:
             raise TransportError(err.value.decode())
         self._inflight.add(name)

@@ -31,11 +31,13 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <memory>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -77,6 +79,16 @@ enum class ReqType : uint8_t {
   kAllgatherRing = 6,
   // Large broadcast (root-elected): chunk-pipelined chain from the root.
   kBroadcastRing = 7,
+  // Large alltoall on the peer data plane: direct pairwise block exchange
+  // over the full-duplex peer-socket mesh (every rank sends N-1 blocks
+  // straight to their destinations), so per-rank traffic is
+  // (N-1)/N · payload independent of world size — the star would relay
+  // N · payload through rank 0 in each direction.
+  kAlltoallRing = 8,
+  // Large reducescatter: the reduce-scatter PHASE of the ring allreduce
+  // alone (each rank ends owning its fully-reduced block); per-rank
+  // traffic (N-1)/N · payload, again world-size independent.
+  kReducescatterRing = 9,
 };
 enum class RespType : uint8_t {
   kAllreduce = 0,
@@ -99,6 +111,8 @@ enum class RespType : uint8_t {
   // MPI_Bcast (mpi_ops.cc:1134-1136). Only the ROOT elects (it alone
   // ships payload); non-roots follow the plan.
   kBroadcastRing = 10,
+  kAlltoallRing = 11,       // mesh plan: direct pairwise block exchange
+  kReducescatterRing = 12,  // ring plan: reduce-scatter phase only
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -151,8 +165,19 @@ const char* ReqTypeName(ReqType t) {
     case ReqType::kAllreduceRing: return "ALLREDUCE_RING";
     case ReqType::kAllgatherRing: return "ALLGATHER_RING";
     case ReqType::kBroadcastRing: return "BROADCAST_RING";
+    case ReqType::kAlltoallRing: return "ALLTOALL_RING";
+    case ReqType::kReducescatterRing: return "REDUCESCATTER_RING";
   }
   return "UNKNOWN";
+}
+
+// Defense-in-depth for direct/nonconforming clients: a request whose type
+// byte is outside the known enum must become a NAMED validation error, not
+// fall through response-construction switches into a default-initialized
+// Response (protocol-version checks already reject mixed builds at hello).
+bool KnownReqType(ReqType t) {
+  return static_cast<uint8_t>(t) <=
+         static_cast<uint8_t>(ReqType::kReducescatterRing);
 }
 
 int DTypeSize(DType t) {
@@ -181,7 +206,48 @@ enum class MsgTag : uint8_t {
 // different builds — exactly the cross-rank config skew init must reject
 // (the analog of the reference's per-tensor placement validation,
 // mpi_ops.cc:439-449, moved to init time where TPU worlds can check it).
-constexpr int32_t kProtocolVersion = 4;
+// v5: ring election extended to alltoall/reducescatter; hello may carry an
+// advertise-address suffix (HOROVOD_RING_ADVERTISE_ADDR).
+constexpr int32_t kProtocolVersion = 5;
+
+// ---------------------------------------------------------------------------
+// Env parsing. atoll/atof would silently truncate ("4M" -> 4) or zero out
+// garbage, degrading performance with no diagnostic; reject trailing
+// characters loudly and keep the default instead.
+// ---------------------------------------------------------------------------
+
+long long ParseEnvI64(const char* name, long long dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  errno = 0;
+  long long out = strtoll(v, &end, 10);
+  if (end == v || *end != '\0' || errno == ERANGE) {
+    fprintf(stderr,
+            "hvdcoord: ignoring malformed %s=\"%s\" (expected a plain "
+            "integer; size suffixes like \"4M\" are not supported) — "
+            "using default %lld\n",
+            name, v, dflt);
+    return dflt;
+  }
+  return out;
+}
+
+double ParseEnvF64(const char* name, double dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  errno = 0;
+  double out = strtod(v, &end);
+  if (end == v || *end != '\0' || errno == ERANGE) {
+    fprintf(stderr,
+            "hvdcoord: ignoring malformed %s=\"%s\" (expected a plain "
+            "number) — using default %g\n",
+            name, v, dflt);
+    return dflt;
+  }
+  return out;
+}
 
 struct Request {
   int32_t rank = -1;
@@ -296,9 +362,13 @@ std::string EncodeResponse(const Response& r) {
   }
   b.PutI32(static_cast<int32_t>(r.ring_peers.size()));
   for (const auto& p : r.ring_peers) b.PutStr(p);
-  // dtype rides the wire for ring PLANS: a non-root broadcast client has
-  // no stash, so the plan itself must size the receive buffer.
+  // dtype AND shape ride the wire for ring PLANS: a non-root broadcast
+  // client has no stash, so the plan itself must size the receive buffer
+  // (shape was coordinator-local before v5 — the r3 chain sized non-root
+  // buffers from an empty shape).
   b.PutU8(static_cast<uint8_t>(r.dtype));
+  b.PutU8(static_cast<uint8_t>(r.shape.size()));
+  for (int64_t d : r.shape) b.PutI64(d);
   b.PutStr(r.payload);
   return b.str();
 }
@@ -318,6 +388,8 @@ Response DecodeResponse(Reader& rd) {
   int np = rd.GetI32();
   for (int i = 0; i < np; i++) r.ring_peers.push_back(rd.GetStr());
   r.dtype = static_cast<DType>(rd.GetU8());
+  int nd = rd.GetU8();
+  for (int i = 0; i < nd; i++) r.shape.push_back(rd.GetI64());
   r.payload = rd.GetStr();
   return r;
 }
@@ -594,10 +666,8 @@ class Coordinator {
         stall_secs_(stall_secs) {
     // Batch-window width (the reference's 5 ms background-tick period,
     // mpi_ops.cc:1295); tunable for latency-sensitive eager workloads.
-    if (const char* t = getenv("HOROVOD_COORD_TICK_MS")) {
-      tick_ms_ = atoi(t);
-      if (tick_ms_ < 0) tick_ms_ = 0;
-    }
+    tick_ms_ = static_cast<int>(ParseEnvI64("HOROVOD_COORD_TICK_MS", 5));
+    if (tick_ms_ < 0) tick_ms_ = 0;
     if (!timeline_path.empty()) timeline_.Open(timeline_path);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
@@ -651,9 +721,23 @@ class Coordinator {
                  sizeof(hello_timeout));
       std::string hello;
       std::string reject;
+      std::string advertise;
       int32_t rank = -1;
       int32_t peer_port = 0;
-      if (!RecvFrame(fd, &hello) || hello.size() != 16) {
+      bool got = RecvFrame(fd, &hello);
+      if (got && hello.size() == 12) {
+        // Pre-v4 builds sent a 12-byte {rank, size, version} hello: read
+        // far enough to emit the SPECIFIC version-mismatch diagnostic
+        // instead of the generic malformed-frame one.
+        int32_t cver;
+        memcpy(&rank, hello.data(), 4);
+        memcpy(&cver, hello.data() + 8, 4);
+        std::ostringstream o;
+        o << "protocol version mismatch: coordinator speaks v"
+          << kProtocolVersion << ", rank " << rank << " speaks v" << cver
+          << " (pre-v4 build; mixed horovod_tpu builds in one world)";
+        reject = o.str();
+      } else if (!got || hello.size() < 16) {
         reject = "malformed hello frame (client/coordinator build mismatch?)";
       } else {
         int32_t csize, cver;
@@ -661,6 +745,10 @@ class Coordinator {
         memcpy(&csize, hello.data() + 4, 4);
         memcpy(&cver, hello.data() + 8, 4);
         memcpy(&peer_port, hello.data() + 12, 4);
+        // Optional suffix: the rank's advertised ring data-plane address
+        // (HOROVOD_RING_ADVERTISE_ADDR) for NAT/multi-homed hosts where
+        // the getpeername() source IP is not reachable by ring neighbors.
+        if (hello.size() > 16) advertise = hello.substr(16);
         std::ostringstream o;
         if (cver != kProtocolVersion) {
           o << "protocol version mismatch: coordinator speaks v"
@@ -696,17 +784,26 @@ class Coordinator {
       setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &no_timeout,
                  sizeof(no_timeout));
       client_fds_[rank] = fd;
-      // Record the rank's ring data-plane address: the IP this connection
-      // came from + the peer-listen port announced in the hello.
+      // Record the rank's ring data-plane address: its advertised address
+      // if it announced one (NAT / multi-homed hosts), else the IP this
+      // connection came from + the peer-listen port from the hello.
       {
-        sockaddr_in peer{};
-        socklen_t plen = sizeof(peer);
-        char ip[INET_ADDRSTRLEN] = "127.0.0.1";
-        if (getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) == 0)
-          inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
-        std::ostringstream a;
-        a << ip << ":" << peer_port;
         if (peer_addrs_.empty()) peer_addrs_.assign(size_, std::string());
+        std::ostringstream a;
+        if (!advertise.empty()) {
+          if (advertise.find(':') != std::string::npos)
+            a << advertise;  // full "ip:port" override
+          else
+            a << advertise << ":" << peer_port;
+        } else {
+          sockaddr_in peer{};
+          socklen_t plen = sizeof(peer);
+          char ip[INET_ADDRSTRLEN] = "127.0.0.1";
+          if (getpeername(fd, reinterpret_cast<sockaddr*>(&peer), &plen) ==
+              0)
+            inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+          a << ip << ":" << peer_port;
+        }
         peer_addrs_[rank] = a.str();
       }
       accepted++;
@@ -910,6 +1007,20 @@ class Coordinator {
     std::sort(requests.begin(), requests.end(),
               [](const Request& a, const Request& b) { return a.rank < b.rank; });
 
+    // Unknown type bytes (a direct/nonconforming client; conforming mixed
+    // builds are already rejected at hello by the version check) must
+    // produce a named error, never reach the op switches below.
+    for (auto& r : requests) {
+      if (!KnownReqType(r.type)) {
+        err << "Unknown collective operation type "
+            << static_cast<int>(r.type) << " announced by rank " << r.rank
+            << " (nonconforming client).";
+        resp.type = RespType::kError;
+        resp.error = err.str();
+        return resp;
+      }
+    }
+
     DType dtype = requests[0].dtype;
     resp.dtype = dtype;
     for (auto& r : requests) {
@@ -959,8 +1070,21 @@ class Coordinator {
       }
     }
 
+    // A kBroadcastRing that survived normalization means every announcer
+    // sent it from a NON-root rank (only possible with a nonconforming or
+    // direct client — conforming non-roots always announce plain
+    // BROADCAST). It must not skip root validation below.
+    if (op == ReqType::kBroadcastRing) {
+      err << "BROADCAST_RING announced by a non-root rank (only the "
+          << "broadcast root elects the ring plane; nonconforming client).";
+      resp.type = RespType::kError;
+      resp.error = err.str();
+      return resp;
+    }
+
     if (op == ReqType::kAllreduce || op == ReqType::kReducescatter ||
-        op == ReqType::kAllreduceRing) {
+        op == ReqType::kAllreduceRing ||
+        op == ReqType::kReducescatterRing) {
       RedOp rop = requests[0].red_op;
       for (auto& r : requests) {
         if (r.red_op != rop) {
@@ -976,7 +1100,8 @@ class Coordinator {
 
     if (op == ReqType::kAllreduce || op == ReqType::kBroadcast ||
         op == ReqType::kAlltoall || op == ReqType::kReducescatter ||
-        op == ReqType::kAllreduceRing) {
+        op == ReqType::kAllreduceRing || op == ReqType::kAlltoallRing ||
+        op == ReqType::kReducescatterRing) {
       const auto& shape = requests[0].shape;
       for (auto& r : requests) {
         if (r.shape != shape) {
@@ -1051,7 +1176,8 @@ class Coordinator {
       }
     }
 
-    if (op == ReqType::kAlltoall || op == ReqType::kReducescatter) {
+    if (op == ReqType::kAlltoall || op == ReqType::kReducescatter ||
+        op == ReqType::kAlltoallRing || op == ReqType::kReducescatterRing) {
       const auto& shape0 = requests[0].shape;
       if (shape0.empty() || shape0[0] % size_ != 0) {
         err << ReqTypeName(op) << " requires a first dimension divisible by "
@@ -1077,6 +1203,8 @@ class Coordinator {
       case ReqType::kAllreduceRing: act = "RING_PLAN"; break;
       case ReqType::kAllgatherRing: act = "RING_PLAN"; break;
       case ReqType::kBroadcastRing: act = "RING_PLAN"; break;
+      case ReqType::kAlltoallRing: act = "RING_PLAN"; break;
+      case ReqType::kReducescatterRing: act = "RING_PLAN"; break;
     }
     if (timeline_.enabled()) {
       timeline_.Start(resp.name, ReqTypeName(op));  // top-level Start
@@ -1148,6 +1276,22 @@ class Coordinator {
         resp.ring_peers = peer_addrs_;
         break;
       }
+      case ReqType::kAlltoallRing: {
+        // Mesh plan: clients exchange blocks pairwise among themselves.
+        resp.type = RespType::kAlltoallRing;
+        resp.shape = requests[0].shape;
+        resp.ring_peers = peer_addrs_;
+        break;
+      }
+      case ReqType::kReducescatterRing: {
+        // Ring plan: clients run the reduce-scatter phase themselves.
+        resp.type = RespType::kReducescatterRing;
+        resp.shape = requests[0].shape;
+        resp.ring_peers = peer_addrs_;
+        break;
+      }
+      case ReqType::kBroadcastRing:
+        break;  // unreachable: rejected above (non-root BROADCAST_RING)
       case ReqType::kReducescatter: {
         // Sum all tensors, rank r receives block r of the first dimension
         // (lax.psum_scatter tiled semantics).
@@ -1310,29 +1454,27 @@ class Client {
  public:
   Client(int rank, int size, const std::string& host, int port)
       : rank_(rank), size_(size) {
-    // Ring data-plane threshold (bytes): allreduces at or above it skip the
-    // star and ring client-to-client. 0 disables. Must agree across ranks
-    // (skew produces a self-explaining ALLREDUCE vs ALLREDUCE_RING
-    // mismatch error at negotiation).
-    ring_threshold_ = 4 << 20;
-    if (const char* t = getenv("HOROVOD_RING_THRESHOLD")) {
-      ring_threshold_ = atoll(t);
-      if (ring_threshold_ < 0) ring_threshold_ = 0;
-    }
+    // Ring data-plane threshold (bytes): collectives at or above it skip
+    // the star and move data client-to-client. 0 disables. Must agree
+    // across ranks (skew produces a self-explaining ALLREDUCE vs
+    // ALLREDUCE_RING mismatch error at negotiation).
+    ring_threshold_ = ParseEnvI64("HOROVOD_RING_THRESHOLD", 4 << 20);
+    if (ring_threshold_ < 0) ring_threshold_ = 0;
+    if (rank_ == 0 && getenv("HOROVOD_RING_THRESHOLD"))
+      fprintf(stderr, "hvdcoord: ring threshold resolved to %lld bytes\n",
+              static_cast<long long>(ring_threshold_));
     // Strict stall mode: Wait() fails with a StalledError after this many
     // seconds (0 = off; the reference only warns, mpi_ops.cc:1153-1196).
-    if (const char* t = getenv("HOROVOD_STALL_TIMEOUT")) {
-      stall_timeout_secs_ = atof(t);
-      if (stall_timeout_secs_ < 0) stall_timeout_secs_ = 0;
-    }
+    stall_timeout_secs_ = ParseEnvF64("HOROVOD_STALL_TIMEOUT", 0.0);
+    if (stall_timeout_secs_ < 0) stall_timeout_secs_ = 0;
     // Ring data-plane IO bound (seconds): peer connect/accept and every
     // per-chunk send/recv must finish within it, so a rank dying mid-ring
     // degrades to a TransportError on the survivors instead of an
     // unbounded block on a silent socket.
-    if (const char* t = getenv("HOROVOD_RING_IO_TIMEOUT")) {
-      ring_io_secs_ = atoi(t);
-      if (ring_io_secs_ < 1) ring_io_secs_ = 1;
-    }
+    ring_io_secs_ =
+        static_cast<int>(ParseEnvI64("HOROVOD_RING_IO_TIMEOUT", 30));
+    if (ring_io_secs_ < 1) ring_io_secs_ = 1;
+    peer_fds_.assign(size_, -1);
     // Peer-listen socket for the ring data plane (ephemeral port, announced
     // in the hello; the left ring neighbor connects here).
     peer_listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -1346,7 +1488,7 @@ class Client {
       paddr.sin_port = 0;
       if (bind(peer_listen_fd_, reinterpret_cast<sockaddr*>(&paddr),
                sizeof(paddr)) == 0 &&
-          listen(peer_listen_fd_, 1) == 0) {
+          listen(peer_listen_fd_, size) == 0) {  // mesh: several peers connect at once
         socklen_t alen = sizeof(paddr);
         if (getsockname(peer_listen_fd_,
                         reinterpret_cast<sockaddr*>(&paddr), &alen) == 0)
@@ -1382,6 +1524,28 @@ class Client {
     hello.append(reinterpret_cast<char*>(&size_), 4);
     hello.append(reinterpret_cast<char*>(&ver), 4);
     hello.append(reinterpret_cast<char*>(&pport), 4);
+    // Optional suffix: explicit ring data-plane address for NAT or
+    // multi-homed hosts where the coordinator's getpeername() view of us
+    // is not reachable by our ring neighbors ("ip" or "ip:port").
+    // Validate the IPv4 literal HERE (same loud-rejection standard as
+    // ParseEnvI64): a hostname or typo would otherwise zero out
+    // inet_pton in every peer's connector and surface 30 s later as a
+    // generic TransportError pointing nowhere.
+    if (const char* adv = getenv("HOROVOD_RING_ADVERTISE_ADDR")) {
+      std::string a(adv);
+      std::string ip = a.substr(0, a.find(':'));
+      in_addr probe{};
+      if (ip.empty() || inet_pton(AF_INET, ip.c_str(), &probe) != 1) {
+        fprintf(stderr,
+                "hvdcoord: ignoring malformed HOROVOD_RING_ADVERTISE_ADDR"
+                "=\"%s\" (expected an IPv4 literal \"a.b.c.d\" or "
+                "\"a.b.c.d:port\"; hostnames are not resolved) — falling "
+                "back to the getpeername-derived address\n",
+                adv);
+      } else {
+        hello.append(a);
+      }
+    }
     SendFrame(fd_, send_mu_, hello);
     // Synchronous ack: the coordinator validates {rank, size, version}
     // before admitting us — misconfigured worlds fail HERE with a message,
@@ -1434,8 +1598,7 @@ class Client {
       ::close(fd_);
       fd_ = -1;
     }
-    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
-    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
+    ClosePeerFds();  // recv thread has exited; safe to own the table now
     if (peer_listen_fd_ >= 0) { ::close(peer_listen_fd_); peer_listen_fd_ = -1; }
   }
 
@@ -1444,17 +1607,34 @@ class Client {
     return SendFrame(fd_, send_mu_, EncodeRequest(req));
   }
 
-  // Enqueue with ring election: a large allreduce/allgather is announced
-  // WITHOUT its payload (kAllreduceRing/kAllgatherRing); the bytes stay
-  // here until the coordinator's ring plan arrives, then move
-  // client-to-client. Everything else takes the star.
-  bool Submit(Request req) {
-    bool ringable =
+  // Whether the client-to-client data plane can run on this rank (the
+  // ephemeral peer-listen socket bound successfully at init).
+  bool peer_plane_available() const { return peer_listen_fd_ >= 0; }
+
+  // Enqueue with ring election: a large collective is announced WITHOUT
+  // its payload (k*Ring); the bytes stay here until the coordinator's
+  // ring/mesh plan arrives, then move client-to-client. Everything else
+  // takes the star. `flags` is the per-call plane override (the analog of
+  // the reference's per-call device_dense=/device_sparse= placement knobs,
+  // horovod/tensorflow/__init__.py:43-55): 0 = auto (threshold), 1 =
+  // force star, 2 = force the peer plane regardless of size.
+  bool Submit(Request req, int flags = 0) {
+    bool kind_ringable =
         (req.type == ReqType::kAllreduce ||
          req.type == ReqType::kAllgather ||
+         req.type == ReqType::kAlltoall ||
+         req.type == ReqType::kReducescatter ||
          (req.type == ReqType::kBroadcast && req.root_rank == rank_)) &&
-        size_ > 1 && ring_threshold_ > 0 && peer_listen_fd_ >= 0 &&
-        static_cast<int64_t>(req.payload.size()) >= ring_threshold_;
+        size_ > 1 && peer_listen_fd_ >= 0;
+    bool ringable;
+    if (flags == 1) {
+      ringable = false;
+    } else if (flags == 2) {
+      ringable = kind_ringable;
+    } else {
+      ringable = kind_ringable && ring_threshold_ > 0 &&
+                 static_cast<int64_t>(req.payload.size()) >= ring_threshold_;
+    }
     if (ringable) {
       {
         std::lock_guard<std::mutex> l(ring_mu_);
@@ -1462,11 +1642,16 @@ class Client {
                                            req.dtype, req.red_op,
                                            req.shape};
       }
-      req.type = req.type == ReqType::kAllreduce
-                     ? ReqType::kAllreduceRing
-                     : (req.type == ReqType::kAllgather
-                            ? ReqType::kAllgatherRing
-                            : ReqType::kBroadcastRing);
+      switch (req.type) {
+        case ReqType::kAllreduce: req.type = ReqType::kAllreduceRing; break;
+        case ReqType::kAllgather: req.type = ReqType::kAllgatherRing; break;
+        case ReqType::kBroadcast: req.type = ReqType::kBroadcastRing; break;
+        case ReqType::kAlltoall: req.type = ReqType::kAlltoallRing; break;
+        case ReqType::kReducescatter:
+          req.type = ReqType::kReducescatterRing;
+          break;
+        default: break;
+      }
       req.payload.clear();
       if (!Enqueue(req)) {
         std::lock_guard<std::mutex> l(ring_mu_);
@@ -1520,77 +1705,109 @@ class Client {
     std::vector<int64_t> shape;  // own announced shape (row size for ragged)
   };
 
-  bool EnsurePeers(const std::vector<std::string>& peers) {
-    if (peer_out_fd_ >= 0 && peer_in_fd_ >= 0) return true;
-    int right = (rank_ + 1) % size_;
-    int left = (rank_ - 1 + size_) % size_;
-    // Connect to the right neighbor on a helper thread while accepting the
-    // left neighbor here — both directions establish concurrently.
-    std::atomic<int> out_fd{-1};
-    std::thread connector([&] {
-      const std::string& addr = peers[right];
-      size_t c = addr.rfind(':');
-      std::string ip = addr.substr(0, c);
-      int pport = atoi(addr.c_str() + c + 1);
-      // Wall-clock deadline with NON-BLOCKING connects: a blackholed peer
-      // (SYN dropped, no RST) would otherwise park each blocking connect
-      // on the kernel's ~2 min SYN retry schedule and blow through the
-      // documented HOROVOD_RING_IO_TIMEOUT bound by orders of magnitude.
-      auto cdeadline = std::chrono::steady_clock::now() +
-                       std::chrono::seconds(ring_io_secs_);
-      while (std::chrono::steady_clock::now() < cdeadline) {
-        int s = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-        sockaddr_in a{};
-        a.sin_family = AF_INET;
-        a.sin_port = htons(static_cast<uint16_t>(pport));
-        inet_pton(AF_INET, ip.c_str(), &a.sin_addr);
-        int rc = ::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
-        bool up = rc == 0;
-        if (!up && errno == EINPROGRESS) {
-          auto left_ms =
-              std::chrono::duration_cast<std::chrono::milliseconds>(
-                  cdeadline - std::chrono::steady_clock::now())
-                  .count();
-          pollfd pfd{s, POLLOUT, 0};
-          if (left_ms > 0 &&
-              ::poll(&pfd, 1, static_cast<int>(left_ms)) > 0) {
-            int soerr = 0;
-            socklen_t slen = sizeof(soerr);
-            getsockopt(s, SOL_SOCKET, SO_ERROR, &soerr, &slen);
-            up = soerr == 0;
+  // Establish one full-duplex data-plane socket per needed peer, cached in
+  // peer_fds_ and reused across ops (ring neighbors and mesh partners
+  // share the table). Deterministic pair rule: the LOWER rank connects,
+  // the higher accepts — no duplicate cross-connections. Every rank
+  // executes ring/mesh ops in coordinator response order, so establishment
+  // is globally ordered and cannot interleave across ops.
+  bool EnsurePeerFds(const std::vector<std::string>& peers,
+                     const std::vector<int>& needed) {
+    std::vector<int> to_connect, to_accept;
+    for (int q : needed) {
+      if (q == rank_ || peer_fds_[q] >= 0) continue;
+      // Dedupe: at N=2 the ring's right and left neighbor are the SAME
+      // rank — one full-duplex socket serves both directions; a duplicate
+      // entry would spawn two connectors and desynchronize the pair.
+      auto& side = rank_ < q ? to_connect : to_accept;
+      bool dup = false;
+      for (int e : side) dup = dup || e == q;
+      if (!dup) side.push_back(q);
+    }
+    if (to_connect.empty() && to_accept.empty()) return true;
+
+    // Connect-side peers (all higher-ranked): one helper thread each, with
+    // NON-BLOCKING connects under a wall-clock deadline — a blackholed
+    // peer (SYN dropped, no RST) would otherwise park each blocking
+    // connect on the kernel's ~2 min SYN retry schedule and blow through
+    // the documented HOROVOD_RING_IO_TIMEOUT bound by orders of magnitude.
+    std::vector<int> connected(to_connect.size(), -1);
+    std::vector<std::thread> connectors;
+    for (size_t k = 0; k < to_connect.size(); k++) {
+      connectors.emplace_back([&, k] {
+        const std::string& addr = peers[to_connect[k]];
+        size_t c = addr.rfind(':');
+        std::string ip = addr.substr(0, c);
+        int pport = atoi(addr.c_str() + c + 1);
+        auto cdeadline = std::chrono::steady_clock::now() +
+                         std::chrono::seconds(ring_io_secs_);
+        while (std::chrono::steady_clock::now() < cdeadline) {
+          int s = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+          sockaddr_in a{};
+          a.sin_family = AF_INET;
+          a.sin_port = htons(static_cast<uint16_t>(pport));
+          if (inet_pton(AF_INET, ip.c_str(), &a.sin_addr) != 1) {
+            // Unresolvable peer address: retrying cannot help; fail the
+            // op now with the cause on stderr instead of burning the
+            // full IO timeout connecting to 0.0.0.0.
+            fprintf(stderr,
+                    "hvdcoord: rank %d has unparseable ring data-plane "
+                    "address \"%s\" (check HOROVOD_RING_ADVERTISE_ADDR)\n",
+                    to_connect[k], addr.c_str());
+            ::close(s);
+            return;
           }
-        }
-        if (up) {
-          // Back to blocking IO with the ring bound on sends.
-          int fl = fcntl(s, F_GETFL, 0);
-          fcntl(s, F_SETFL, fl & ~O_NONBLOCK);
-          int one = 1;
-          setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-          timeval io_timeout{ring_io_secs_, 0};
-          setsockopt(s, SOL_SOCKET, SO_SNDTIMEO, &io_timeout,
-                     sizeof(io_timeout));
-          int32_t me = rank_;
-          if (::send(s, &me, 4, MSG_NOSIGNAL) == 4) {
-            out_fd.store(s);
+          int rc = ::connect(s, reinterpret_cast<sockaddr*>(&a), sizeof(a));
+          bool up = rc == 0;
+          if (!up && errno == EINPROGRESS) {
+            auto left_ms =
+                std::chrono::duration_cast<std::chrono::milliseconds>(
+                    cdeadline - std::chrono::steady_clock::now())
+                    .count();
+            pollfd pfd{s, POLLOUT, 0};
+            if (left_ms > 0 &&
+                ::poll(&pfd, 1, static_cast<int>(left_ms)) > 0) {
+              int soerr = 0;
+              socklen_t slen = sizeof(soerr);
+              getsockopt(s, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+              up = soerr == 0;
+            }
+          }
+          if (up) {
+            // Back to blocking IO with the ring bound on both directions.
+            int fl = fcntl(s, F_GETFL, 0);
+            fcntl(s, F_SETFL, fl & ~O_NONBLOCK);
+            int one = 1;
+            setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            timeval io_timeout{ring_io_secs_, 0};
+            setsockopt(s, SOL_SOCKET, SO_SNDTIMEO, &io_timeout,
+                       sizeof(io_timeout));
+            setsockopt(s, SOL_SOCKET, SO_RCVTIMEO, &io_timeout,
+                       sizeof(io_timeout));
+            int32_t me = rank_;
+            if (::send(s, &me, 4, MSG_NOSIGNAL) == 4) {
+              connected[k] = s;  // each thread writes its own slot
+              return;
+            }
+            ::close(s);
             return;
           }
           ::close(s);
-          return;
+          std::this_thread::sleep_for(std::chrono::milliseconds(50));
         }
-        ::close(s);
-        std::this_thread::sleep_for(std::chrono::milliseconds(50));
-      }
-    });
-    // Accept the left neighbor (30 s bound; a ring plan means every rank
-    // got the same response, so the neighbor is coming). Stray connections
-    // to the data port (port scanners, probes) must not hang or kill the
-    // rank — same hardening standard as the control-plane hello: bound the
-    // identity read with a recv timeout, and keep accepting until the real
-    // neighbor shows up or the deadline passes.
-    int in_fd = -1;
+      });
+    }
+
+    // Accept-side peers (all lower-ranked; a plan means every rank got the
+    // same response, so they are coming). Stray connections to the data
+    // port (port scanners, probes) must not hang or kill the rank — same
+    // hardening standard as the control-plane hello: bound the identity
+    // read with a recv timeout, classify by identity, and keep accepting
+    // until every expected peer shows up or the deadline passes.
+    size_t missing = to_accept.size();
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::seconds(ring_io_secs_);
-    while (in_fd < 0) {
+    while (missing > 0) {
       auto left_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
                          deadline - std::chrono::steady_clock::now())
                          .count();
@@ -1605,55 +1822,83 @@ class Client {
       setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &id_timeout,
                  sizeof(id_timeout));
       int32_t who = -1;
-      if (RecvAll(fd, &who, 4) && who == left) {
-        // Keep the IO bound for every future chunk recv: a neighbor dying
-        // mid-ring must surface as a failed step (-> TransportError), not
-        // an unbounded block that also starves the control socket.
+      bool expected = false;
+      if (RecvAll(fd, &who, 4)) {
+        for (int q : to_accept)
+          expected = expected || (q == who && peer_fds_[who] < 0);
+      }
+      if (expected) {
+        // Keep the IO bound for every future chunk send/recv: a peer
+        // dying mid-op must surface as a failed step (-> TransportError),
+        // not an unbounded block that also starves the control socket.
         timeval io_timeout{ring_io_secs_, 0};
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &io_timeout,
                    sizeof(io_timeout));
-        in_fd = fd;
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &io_timeout,
+                   sizeof(io_timeout));
+        peer_fds_[who] = fd;
+        missing--;
       } else {
         fprintf(stderr,
-                "hvdcoord: rejecting stray connection on ring data port "
-                "(expected rank %d)\n", left);
+                "hvdcoord: rejecting stray connection on peer data port "
+                "(got identity %d)\n", who);
         ::close(fd);  // stray/garbled: keep accepting
       }
     }
-    connector.join();
-    peer_out_fd_ = out_fd.load();
-    peer_in_fd_ = in_fd;
-    if (peer_out_fd_ >= 0 && peer_in_fd_ >= 0) return true;
-    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
-    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
-    return false;
+    for (auto& t : connectors) t.join();
+    for (size_t k = 0; k < to_connect.size(); k++)
+      if (connected[k] >= 0) peer_fds_[to_connect[k]] = connected[k];
+    bool ok = missing == 0;
+    for (int q : to_connect) ok = ok && peer_fds_[q] >= 0;
+    return ok;
   }
 
-  // Raw fixed-size exchange with both neighbors: send `snd` right while
-  // receiving `rcv_n` bytes from the left. The send rides a helper thread
-  // so a full TCP buffer cannot deadlock the step (everyone sends and
-  // receives simultaneously). Thread spawn cost (~10 us) is noise against
-  // the >=MB-scale transfers the ring carries; both sockets have
-  // HOROVOD_RING_IO_TIMEOUT bounds so a dead peer fails the step.
-  bool RingStep(const char* snd, size_t snd_n, char* rcv, size_t rcv_n) {
+  void ClosePeerFds() {
+    for (int& fd : peer_fds_)
+      if (fd >= 0) { ::close(fd); fd = -1; }
+  }
+
+  // Raw fixed-size exchange with two peers: send `snd` on snd_fd while
+  // receiving `rcv_n` bytes from rcv_fd (for ring ops these are the right
+  // and left neighbors; for the mesh alltoall, the step's partners — the
+  // two may be the same full-duplex socket at N=2). The send rides a
+  // helper thread so a full TCP buffer cannot deadlock the step (everyone
+  // sends and receives simultaneously). Thread spawn cost (~10 us) is
+  // noise against the >=MB-scale transfers the peer plane carries; both
+  // sockets have HOROVOD_RING_IO_TIMEOUT bounds so a dead peer fails the
+  // step.
+  bool RingStep(int snd_fd, const char* snd, size_t snd_n, int rcv_fd,
+                char* rcv, size_t rcv_n) {
     std::atomic<bool> send_ok{true};
     std::thread sender([&] {
       size_t off = 0;
       while (off < snd_n) {
-        ssize_t n = ::send(peer_out_fd_, snd + off, snd_n - off,
-                           MSG_NOSIGNAL);
+        ssize_t n = ::send(snd_fd, snd + off, snd_n - off, MSG_NOSIGNAL);
         if (n <= 0) { send_ok.store(false); return; }
         off += static_cast<size_t>(n);
       }
     });
-    bool recv_ok = rcv_n == 0 || RecvAll(peer_in_fd_, rcv, rcv_n);
+    bool recv_ok = rcv_n == 0 || RecvAll(rcv_fd, rcv, rcv_n);
     sender.join();
     if (send_ok.load()) ring_bytes_sent_ += snd_n;
     return send_ok.load() && recv_ok;
   }
 
+  // Ring-neighbor convenience wrapper (send right, receive from left).
+  bool NeighborStep(const char* snd, size_t snd_n, char* rcv, size_t rcv_n) {
+    int right = (rank_ + 1) % size_;
+    int left = (rank_ - 1 + size_) % size_;
+    return RingStep(peer_fds_[right], snd, snd_n, peer_fds_[left], rcv,
+                    rcv_n);
+  }
+
+  bool EnsureRingNeighbors(const std::vector<std::string>& peers) {
+    std::vector<int> needed{(rank_ + 1) % size_, (rank_ - 1 + size_) % size_};
+    return EnsurePeerFds(peers, needed);
+  }
+
   bool RunRing(const Response& plan, RingWork work, std::string* out) {
-    if (!EnsurePeers(plan.ring_peers)) return false;
+    if (!EnsureRingNeighbors(plan.ring_peers)) return false;
     const int N = size_;
     std::string& buf = work.payload;
     const size_t esz = static_cast<size_t>(DTypeSize(work.dtype));
@@ -1672,7 +1917,7 @@ class Client {
     for (int s = 0; s <= N - 2; s++) {
       int snd = (rank_ - s + N) % N;
       int rcv = (rank_ - s - 1 + N) % N;
-      if (!RingStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
+      if (!NeighborStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
         return false;
       // In-place accumulate; order differs from the star's rank-order
       // reduce only in float rounding (as MPI's ring does).
@@ -1683,12 +1928,64 @@ class Client {
     for (int s = 0; s <= N - 2; s++) {
       int snd = (rank_ + 1 - s + N) % N;
       int rcv = (rank_ - s + N) % N;
-      if (!RingStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
+      if (!NeighborStep(chunk(snd), clen(snd), &incoming[0], clen(rcv)))
         return false;
       memcpy(chunk(rcv), incoming.data(), clen(rcv));
     }
     ring_ops_++;
     *out = std::move(buf);
+    return true;
+  }
+
+  // Ring reducescatter: the reduce-scatter PHASE of the ring allreduce
+  // alone, with chunk indices shifted by -1 so rank r ends owning its own
+  // fully-reduced block r (the psum_scatter tiled semantics the star path
+  // implements host-side). Blocks are exact (first dim divisible by N,
+  // validated at negotiation). Per-rank traffic = (N-1)/N · payload.
+  bool RunRingScatter(const Response& plan, RingWork work,
+                      std::string* out) {
+    if (!EnsureRingNeighbors(plan.ring_peers)) return false;
+    const int N = size_;
+    std::string& buf = work.payload;
+    const size_t block = buf.size() / N;
+    std::string incoming(block, '\0');
+    for (int s = 0; s <= N - 2; s++) {
+      int snd = (rank_ - s - 1 + 2 * N) % N;
+      int rcv = (rank_ - s - 2 + 2 * N) % N;
+      if (!NeighborStep(&buf[snd * block], block, &incoming[0], block))
+        return false;
+      ReducePayloadRaw(work.dtype, work.red_op, &buf[rcv * block],
+                       incoming.data(), block);
+    }
+    out->assign(buf.data() + rank_ * block, block);
+    ring_ops_++;
+    return true;
+  }
+
+  // Mesh alltoall: direct pairwise block exchange over the full-duplex
+  // peer-socket mesh. At step d, send block (r+d) to rank (r+d) while
+  // receiving block r of rank (r-d) from (r-d) — pairwise symmetric, so
+  // RingStep's concurrent send+recv cannot deadlock. Per-rank traffic =
+  // (N-1)/N · payload, independent of world size (the star relays
+  // N · payload through rank 0 in each direction).
+  bool RunMeshAlltoall(const Response& plan, RingWork work,
+                       std::string* out) {
+    std::vector<int> needed;
+    for (int q = 0; q < size_; q++)
+      if (q != rank_) needed.push_back(q);
+    if (!EnsurePeerFds(plan.ring_peers, needed)) return false;
+    const size_t block = work.payload.size() / size_;
+    out->assign(work.payload.size(), '\0');
+    memcpy(&(*out)[0] + rank_ * block, work.payload.data() + rank_ * block,
+           block);
+    for (int d = 1; d < size_; d++) {
+      int to = (rank_ + d) % size_;
+      int from = (rank_ - d + size_) % size_;
+      if (!RingStep(peer_fds_[to], work.payload.data() + to * block, block,
+                    peer_fds_[from], &(*out)[0] + from * block, block))
+        return false;
+    }
+    ring_ops_++;
     return true;
   }
 
@@ -1698,7 +1995,7 @@ class Client {
   // rank-ordered concatenation. Per-rank traffic = output - own block.
   bool RunRingGather(const Response& plan, RingWork work,
                      std::string* out) {
-    if (!EnsurePeers(plan.ring_peers)) return false;
+    if (!EnsureRingNeighbors(plan.ring_peers)) return false;
     const int N = size_;
     int64_t row_bytes = static_cast<int64_t>(DTypeSize(work.dtype));
     for (size_t i = 1; i < work.shape.size(); i++)
@@ -1714,8 +2011,9 @@ class Client {
     for (int s = 0; s <= N - 2; s++) {
       int snd = (rank_ - s + N) % N;
       int rcv = (rank_ - s - 1 + N) % N;
-      if (!RingStep(out->data() + off[snd], static_cast<size_t>(nb[snd]),
-                    &(*out)[0] + off[rcv], static_cast<size_t>(nb[rcv])))
+      if (!NeighborStep(out->data() + off[snd],
+                        static_cast<size_t>(nb[snd]),
+                        &(*out)[0] + off[rcv], static_cast<size_t>(nb[rcv])))
         return false;
     }
     ring_ops_++;
@@ -1729,7 +2027,7 @@ class Client {
   // = payload exactly.
   bool RunRingBcast(const Response& plan, std::string root_payload,
                     std::string* out) {
-    if (!EnsurePeers(plan.ring_peers)) return false;
+    if (!EnsureRingNeighbors(plan.ring_peers)) return false;
     int root = static_cast<int>(plan.sizes.empty() ? 0 : plan.sizes[0]);
     int64_t total = DTypeSize(plan.dtype);
     for (int64_t d : plan.shape) total *= d;
@@ -1739,7 +2037,7 @@ class Client {
       *out = std::move(root_payload);
       for (size_t o = 0; o < static_cast<size_t>(total); o += kChunk) {
         size_t l = std::min(kChunk, static_cast<size_t>(total) - o);
-        if (!RingStep(out->data() + o, l, nullptr, 0)) return false;
+        if (!NeighborStep(out->data() + o, l, nullptr, 0)) return false;
       }
     } else {
       out->assign(static_cast<size_t>(total), '\0');
@@ -1747,14 +2045,14 @@ class Client {
       for (size_t o = 0; o < static_cast<size_t>(total); o += kChunk) {
         size_t l = std::min(kChunk, static_cast<size_t>(total) - o);
         // Forward the previous chunk while receiving this one.
-        if (!RingStep(is_last ? nullptr : out->data() + po,
-                      is_last ? 0 : pl, &(*out)[0] + o, l))
+        if (!NeighborStep(is_last ? nullptr : out->data() + po,
+                          is_last ? 0 : pl, &(*out)[0] + o, l))
           return false;
         po = o;
         pl = l;
       }
       if (!is_last && pl > 0) {
-        if (!RingStep(out->data() + po, pl, nullptr, 0)) return false;
+        if (!NeighborStep(out->data() + po, pl, nullptr, 0)) return false;
       }
     }
     ring_ops_++;
@@ -1820,6 +2118,32 @@ class Client {
         if (!RunRingGather(resp, std::move(work), &gathered)) break;
         resp.type = RespType::kAllgather;  // sizes already negotiated
         resp.payload = std::move(gathered);
+      } else if (resp.type == RespType::kAlltoallRing) {
+        RingWork work;
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it == ring_pending_.end()) break;  // protocol violation
+          work = std::move(it->second);
+          ring_pending_.erase(it);
+        }
+        std::string exchanged;
+        if (!RunMeshAlltoall(resp, std::move(work), &exchanged)) break;
+        resp.type = RespType::kAlltoall;
+        resp.payload = std::move(exchanged);
+      } else if (resp.type == RespType::kReducescatterRing) {
+        RingWork work;
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          auto it = ring_pending_.find(resp.name);
+          if (it == ring_pending_.end()) break;  // protocol violation
+          work = std::move(it->second);
+          ring_pending_.erase(it);
+        }
+        std::string scattered;
+        if (!RunRingScatter(resp, std::move(work), &scattered)) break;
+        resp.type = RespType::kReducescatter;
+        resp.payload = std::move(scattered);
       } else if (resp.type == RespType::kAllreduceRing) {
         // NB: a ring op whose wait stall-timed-out keeps its stash here
         // until the plan (or an error) arrives — if the slow ranks do
@@ -1873,11 +2197,10 @@ class Client {
       }
       cv_.notify_all();
     }
-    // Close the ring sockets on the way out so neighbors blocked in a
-    // ring step observe EOF immediately (fast failure cascade) instead of
-    // waiting out their IO timeout.
-    if (peer_out_fd_ >= 0) { ::close(peer_out_fd_); peer_out_fd_ = -1; }
-    if (peer_in_fd_ >= 0) { ::close(peer_in_fd_); peer_in_fd_ = -1; }
+    // Close the peer sockets on the way out so peers blocked in a
+    // ring/mesh step observe EOF immediately (fast failure cascade)
+    // instead of waiting out their IO timeout.
+    ClosePeerFds();
     std::lock_guard<std::mutex> l(mu_);
     dead_ = true;
     cv_.notify_all();
@@ -1915,8 +2238,10 @@ class Client {
   int ring_io_secs_ = 30;
   int peer_listen_fd_ = -1;
   int peer_port_ = 0;
-  int peer_out_fd_ = -1;  // to right neighbor (recv-thread only)
-  int peer_in_fd_ = -1;   // from left neighbor (recv-thread only)
+  // Full-duplex data-plane socket per peer rank (-1 = not established).
+  // Owned by the recv thread (all ring/mesh ops run there in response
+  // order); Shutdown touches it only after joining that thread.
+  std::vector<int> peer_fds_;
   std::mutex ring_mu_;
   std::map<std::string, RingWork> ring_pending_;
   std::mutex send_mu_;
@@ -1992,11 +2317,15 @@ int hvdcoord_size() { return hvdcoord::g()->client ? hvdcoord::g()->size : -1; }
 
 // Non-blocking submit (reference: ComputeAsync + EnqueueTensor*,
 // mpi_ops.cc:1752-1772 — many collectives negotiate concurrently, feeding
-// coordinator-side fusion). Returns 0 ok, 2 transport failure.
+// coordinator-side fusion). `plane` is the per-call placement override
+// (the analog of the reference's device_dense=/device_sparse= knobs,
+// horovod/tensorflow/__init__.py:43-55): 0 auto (HOROVOD_RING_THRESHOLD
+// decides), 1 force the coordinator star, 2 force the client-to-client
+// peer plane. Returns 0 ok, 2 transport failure.
 int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
                     int root_rank, int ndim, const long long* shape,
-                    const void* data, long long nbytes, char* err,
-                    int errlen) {
+                    const void* data, long long nbytes, int plane,
+                    char* err, int errlen) {
   using namespace hvdcoord;
   auto* G = g();
   if (!G->client) {
@@ -2014,7 +2343,18 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
   if (data && nbytes > 0)
     req.payload.assign(reinterpret_cast<const char*>(data),
                        static_cast<size_t>(nbytes));
-  if (!G->client->Submit(std::move(req))) {
+  if (plane == 2 && !G->client->peer_plane_available()) {
+    // An explicit force must not silently degrade to the star: the other
+    // ranks would announce the ring variant and the world would fail with
+    // a misattributed cross-rank mismatch error. Name the real cause.
+    snprintf(err, errlen,
+             "plane=\"ring\" forced but the peer data plane is unavailable "
+             "on rank %d (the ephemeral peer-listen socket failed to bind "
+             "at init — port exhaustion?)",
+             G->rank);
+    return 2;
+  }
+  if (!G->client->Submit(std::move(req), plane)) {
     snprintf(err, errlen, "hvdcoord: send failed (coordinator down?)");
     return 2;
   }
@@ -2071,7 +2411,7 @@ int hvdcoord_run(const char* name, int req_type, int dtype, int red_op,
                  long long* out_nbytes, long long* sizes_out, char* err,
                  int errlen) {
   int rc = hvdcoord_submit(name, req_type, dtype, red_op, root_rank, ndim,
-                           shape, data, nbytes, err, errlen);
+                           shape, data, nbytes, /*plane=*/0, err, errlen);
   if (rc != 0) return rc;
   return hvdcoord_wait(name, out, out_nbytes, sizes_out, err, errlen);
 }

@@ -183,7 +183,7 @@ def _is_per_rank(x) -> bool:
 
 
 def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
-                    root_rank: int = 0):
+                    root_rank: int = 0, plane: str = "auto"):
     w = runtime.world()
     x = jnp.asarray(x)
     per_rank = _is_per_rank(x)
@@ -191,7 +191,12 @@ def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
     if w.coord is not None:
         # Multi-process eager plane: negotiate + validate the name-keyed
         # request across processes before dispatch (host DCN plane).
-        return w.coord.collective(kind, x, name, op=op, root_rank=root_rank)
+        return w.coord.collective(kind, x, name, op=op, root_rank=root_rank,
+                                  plane=plane)
+    if plane != "auto":
+        raise ValueError(
+            f"plane={plane!r} is a multi-process eager-plane knob (star vs "
+            f"client-to-client ring); this world has no coordination plane")
 
     if kind in ("alltoall", "reducescatter"):
         if not per_rank:
@@ -244,7 +249,8 @@ def _eager_dispatch(kind: str, x, name: str, *, op: Op = Op.SUM,
 # ---------------------------------------------------------------------------
 
 def allreduce(tensor, average: bool = True, name: Optional[str] = None,
-              op: Optional[Op] = None, axis_name: str = AXIS):
+              op: Optional[Op] = None, axis_name: str = AXIS,
+              plane: str = "auto"):
     """Sum (or average) ``tensor`` across all ranks.
 
     Parity: ``hvd.allreduce`` (``horovod/tensorflow/__init__.py:43-79``) —
@@ -257,7 +263,12 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     Inside a ``shard_map`` over the world mesh this is a single XLA
     ``all-reduce`` over ICI; eagerly it dispatches a cached compiled
     collective (single-controller) or the host coordination plane
-    (multi-process).
+    (multi-process). ``plane`` routes the multi-process eager data plane
+    per call — ``"auto"`` (``HOROVOD_RING_THRESHOLD`` elects), ``"star"``
+    (coordinator relay) or ``"ring"`` (client-to-client) — the analog of
+    the reference's per-call ``device_dense=`` placement knob
+    (``horovod/tensorflow/__init__.py:43-55``, ``docs/gpus.md:40-45``);
+    ignored in-trace (XLA owns the compiled plane).
     """
     from .sparse import IndexedSlices, allreduce_indexed_slices
     resolved = op if op is not None else (Op.AVERAGE if average else Op.SUM)
@@ -273,10 +284,12 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     if _in_trace():
         return _reduce_in_trace(tensor, resolved, axis_name)
     return _eager_dispatch("allreduce", tensor,
-                           _auto_name("Allreduce", name), op=resolved)
+                           _auto_name("Allreduce", name), op=resolved,
+                           plane=plane)
 
 
-def allgather(tensor, name: Optional[str] = None, axis_name: str = AXIS):
+def allgather(tensor, name: Optional[str] = None, axis_name: str = AXIS,
+              plane: str = "auto"):
     """Concatenate each rank's tensor along dim 0.
 
     Parity: ``hvd.allgather`` (``mpi_ops.py:151-167``) / ``MPI_Allgatherv``
@@ -288,7 +301,8 @@ def allgather(tensor, name: Optional[str] = None, axis_name: str = AXIS):
     """
     if _in_trace():
         return all_gather_invariant(tensor, axis_name, tiled=True)
-    return _eager_dispatch("allgather", tensor, _auto_name("Allgather", name))
+    return _eager_dispatch("allgather", tensor, _auto_name("Allgather", name),
+                           plane=plane)
 
 
 def allgather_ragged(tensor, valid_size, max_size: int,
@@ -305,6 +319,27 @@ def allgather_ragged(tensor, valid_size, max_size: int,
     """
     del name
     n = jnp.shape(tensor)[0]
+    if n > max_size:
+        # Error parity with the coordinator's negotiated-size path: an
+        # input larger than the negotiated maximum is a validation error
+        # (ConstructMPIResponse allgather sizing, mpi_ops.cc:345-405), not
+        # a silent truncation.
+        raise ValueError(
+            f"Mismatched ALLGATHER tensor shapes: tensor has {n} rows but "
+            f"max_size is {max_size}; allgather_ragged cannot truncate "
+            f"(grow max_size or slice the input)")
+    if not isinstance(valid_size, jax.core.Tracer):
+        vs = int(valid_size)
+        if not 0 <= vs <= max_size:
+            raise ValueError(
+                f"Mismatched ALLGATHER tensor shapes: valid_size {vs} is "
+                f"outside [0, max_size={max_size}]; an oversized "
+                f"valid_size would silently drop rows past max_size "
+                f"(negotiated-size parity, mpi_ops.cc:345-405)")
+    else:
+        # Data-dependent valid_size inside jit cannot raise; clamp so an
+        # out-of-range value cannot corrupt the mask or the sizes vector.
+        valid_size = jnp.clip(valid_size, 0, max_size)
     if n != max_size:
         pad = [(0, max_size - n)] + [(0, 0)] * (tensor.ndim - 1)
         tensor = jnp.pad(tensor, pad)
@@ -317,7 +352,7 @@ def allgather_ragged(tensor, valid_size, max_size: int,
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
-              axis_name: str = AXIS):
+              axis_name: str = AXIS, plane: str = "auto"):
     """Every rank receives the root's tensor.
 
     Parity: ``hvd.broadcast`` (``mpi_ops.py:170-190``) / ``MPI_Bcast``
@@ -334,11 +369,13 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     if _in_trace():
         return _broadcast_in_trace(tensor, root_rank, axis_name)
     return _eager_dispatch("broadcast", tensor,
-                           _auto_name("Broadcast", name), root_rank=root_rank)
+                           _auto_name("Broadcast", name), root_rank=root_rank,
+                           plane=plane)
 
 
 def alltoall(tensor, split_axis: int = 0, concat_axis: int = 0,
-             name: Optional[str] = None, axis_name: str = AXIS):
+             name: Optional[str] = None, axis_name: str = AXIS,
+             plane: str = "auto"):
     """All-to-all exchange (TPU-era extra; not in reference v0.11.2 —
     needed by all-to-all sequence/context parallelism, SURVEY §5.7).
 
@@ -355,12 +392,13 @@ def alltoall(tensor, split_axis: int = 0, concat_axis: int = 0,
         raise NotImplementedError(
             "eager alltoall supports split_axis=0/concat_axis=0; transpose "
             "first or call in-trace under shard_map")
-    return _eager_dispatch("alltoall", tensor, _auto_name("Alltoall", name))
+    return _eager_dispatch("alltoall", tensor, _auto_name("Alltoall", name),
+                           plane=plane)
 
 
 def reducescatter(tensor, average: bool = False,
                   name: Optional[str] = None, op: Optional[Op] = None,
-                  axis_name: str = AXIS):
+                  axis_name: str = AXIS, plane: str = "auto"):
     """Reduce-scatter (TPU-era extra): reduce across ranks, then rank ``r``
     keeps block ``r`` of the first dimension.
 
@@ -379,7 +417,8 @@ def reducescatter(tensor, average: bool = False,
             out = out / runtime.size()
         return out
     return _eager_dispatch("reducescatter", tensor,
-                           _auto_name("Reducescatter", name), op=resolved)
+                           _auto_name("Reducescatter", name), op=resolved,
+                           plane=plane)
 
 
 # ---------------------------------------------------------------------------
