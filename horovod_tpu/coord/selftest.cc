@@ -198,6 +198,40 @@ void RankMain(int rank, std::atomic<int>* failures) {
       }
     }
   }
+  // Large STAR round (plane forced): a 256 KiB payload through the
+  // coordinator's host reduction exercises ReduceAllStriped across
+  // stripe boundaries (set HOROVOD_COORD_REDUCE_THREADS>1 + TSan to
+  // race-check the striped path; 1-core hosts run it serial).
+  {
+    const int n = 65536;
+    std::vector<float> v(n);
+    for (int i = 0; i < n; i++) v[i] = float(rank + 1) * float(i % 97);
+    Request req;
+    req.rank = rank;
+    req.type = ReqType::kAllreduce;
+    req.dtype = DType::kF32;
+    req.red_op = RedOp::kSum;
+    req.shape = {n};
+    req.name = "star.big";
+    req.payload = F32Payload(v);
+    if (!client.Submit(std::move(req), /*flags=*/1)) failures->fetch_add(1);
+    Response resp;
+    if (client.Wait("star.big", &resp) != 0 ||
+        resp.payload.size() != size_t(n) * 4) {
+      failures->fetch_add(1);
+    } else {
+      const float* out =
+          reinterpret_cast<const float*>(resp.payload.data());
+      float scale = 0.f;
+      for (int r = 0; r < kSize; r++) scale += float(r + 1);
+      for (int i : {0, 1, 21845, 21846, 43690, 43691, 65535}) {
+        if (std::fabs(out[i] - scale * float(i % 97)) > 1e-2) {
+          failures->fetch_add(1);
+          break;
+        }
+      }
+    }
+  }
   if (client.ring_ops() != 4) failures->fetch_add(1);
   // Bandwidth optimality: each ring allreduce moves 2*(N-1)/N * payload
   // per rank (up to one element of chunk-remainder skew per send); the

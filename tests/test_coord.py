@@ -1000,3 +1000,89 @@ def test_ring_advertise_addr_env():
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {rank}:\n{out}"
         assert f"rank {rank}: ADVERTISE_OK" in out
+
+
+def test_striped_host_reduce_correctness():
+    """HOROVOD_COORD_REDUCE_THREADS>1 stripes the coordinator's host
+    reduction across threads for >=256 KiB star-plane payloads; results
+    must be identical across stripe boundaries (element-aligned stripes,
+    each thread walking all ranks in its range)."""
+    import textwrap
+    size = 3
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, {size}, "127.0.0.1", {port})
+        n = 1 << 18    # 1 MiB of f32, forced onto the star
+        x = (np.arange(n, dtype=np.float32) % 997) * (rank + 1)
+        out = np.asarray(c.collective("allreduce", x, "striped.star",
+                                      plane="star"))
+        expect = (np.arange(n, dtype=np.float32) % 997) * 6.0  # 1+2+3
+        assert np.array_equal(out, expect), np.abs(out - expect).max()
+        assert c.ring_ops() == 0, c.ring_ops()
+        print(f"rank {{rank}}: STRIPED_OK", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu",
+                   HOROVOD_COORD_REDUCE_THREADS="4")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: STRIPED_OK" in out
+
+
+def test_short_payload_rejected_with_named_error():
+    """A payload smaller than the announced shape (only possible with a
+    direct/nonconforming client) must produce a NAMED validation error —
+    the host executors index by the announced shapes, so an unvalidated
+    short payload would be an out-of-bounds read in the coordinator."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import ctypes, os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 2, "127.0.0.1", {port})
+        # Raw ABI: announce shape [1<<16] f32 (256 KiB) but ship 8 bytes.
+        data = np.ones(2, np.float32)
+        shape = (ctypes.c_longlong * 1)(1 << 16)
+        err = ctypes.create_string_buffer(4096)
+        rc = c._lib.hvdcoord_submit(
+            b"short.evil", 0, 6, 0, 0, 1, shape,
+            data.ctypes.data, data.nbytes, 1, err, len(err))
+        assert rc == 0, err.value
+        out = ctypes.c_void_p(); nb = ctypes.c_longlong()
+        sizes = (ctypes.c_longlong * 2)()
+        rc = c._lib.hvdcoord_wait(b"short.evil", ctypes.byref(out),
+                                  ctypes.byref(nb), sizes, err, len(err))
+        assert rc == 1, (rc, err.value)
+        msg = err.value.decode()
+        assert "Mismatched payload size" in msg, msg
+        print(f"rank {{rank}}: SHORT_REJECTED", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: SHORT_REJECTED" in out
