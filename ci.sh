@@ -32,6 +32,21 @@ run_cpu python examples/imagenet_resnet50.py --epochs 1 --image 32 --batch-per-c
 echo "== tpurun launcher smoke (2 ranks, env-world) =="
 python -m horovod_tpu.launcher -np 2 --cpu python tests/launcher_worker.py
 
+echo "== tpurun multi-node smoke (2 simulated hosts x 2 ranks, shared coordinator) =="
+# The mpirun -H host1:2,host2:2 analog (docs/running.md): two launcher
+# invocations on localhost forming one world of 4 over the coordinator.
+MN_PORT=$(python -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+python -m horovod_tpu.launcher -np 2 --cpu --nnodes 2 --node-rank 0 \
+  --coordinator 127.0.0.1:"$MN_PORT" python tests/launcher_worker.py &
+MN_PID=$!
+# If node 1 fails, set -e exits this script — kill the backgrounded node 0
+# too or its ranks sit blocked on collectives holding the stdout pipe open.
+trap 'kill "$MN_PID" 2>/dev/null || true' EXIT
+python -m horovod_tpu.launcher -np 2 --cpu --nnodes 2 --node-rank 1 \
+  --coordinator 127.0.0.1:"$MN_PORT" python tests/launcher_worker.py
+wait "$MN_PID"
+trap - EXIT
+
 echo "== driver contracts =="
 PYTHONPATH= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python __graft_entry__.py

@@ -536,8 +536,17 @@ void ReduceAllStriped(DType t, RedOp op, std::string* acc,
   // -bandwidth bound on most hosts. An EXPLICIT override is honored up to
   // 16 (clamped loudly; silent caps hide why raising the knob stops
   // helping).
-  static const bool explicit_threads =
-      getenv("HOROVOD_COORD_REDUCE_THREADS") != nullptr;
+  // "Explicit" = set AND parseable: a malformed value falls back to
+  // ParseEnvI64's default (hardware_concurrency) and must then also get
+  // the default 4-stripe cap, or the "using default" warning would lie.
+  static const bool explicit_threads = [] {
+    const char* v = getenv("HOROVOD_COORD_REDUCE_THREADS");
+    if (!v || !*v) return false;
+    char* end = nullptr;
+    errno = 0;
+    strtoll(v, &end, 10);
+    return end != v && *end == '\0' && errno != ERANGE;
+  }();
   long long want = explicit_threads ? kThreads
                                     : std::min<long long>(kThreads, 4);
   if (want > 16) {

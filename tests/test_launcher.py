@@ -66,3 +66,64 @@ def test_tpurun_jax_distributed():
     assert len(found) == 2, out.stdout
     assert {r for r, _ in found} == {"0", "1"}, found
     assert len({c for _, c in found}) == 1, f"replicas diverged: {found}"
+
+
+def test_tpurun_multi_node_coord_plane_world4():
+    """The full multi-host operational story (mpirun -H host1:2,host2:2
+    analog, reference docs/running.md:15-45): two tpurun invocations on
+    localhost, each spawning np=2 ranks with --nnodes 2 and a shared
+    --coordinator, must form ONE world of 4 with node-rank arithmetic
+    (node r owns global ranks 2r, 2r+1) and complete every public-API
+    collective across the "hosts" over the host coordination plane."""
+    import socket
+    import re
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.launcher", "-np", "2",
+             "--cpu", "--nnodes", "2", "--node-rank", str(i),
+             "--coordinator", f"127.0.0.1:{port}",
+             sys.executable, WORKER],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=360)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    # Rank arithmetic: node 0 hosts ranks {0,1}, node 1 hosts {2,3}; all
+    # report a world of 4.
+    for node, expect in ((0, {"0", "1"}), (1, {"2", "3"})):
+        found = set(re.findall(r"rank (\d+)/4: LAUNCHER OK", outs[node]))
+        assert found == expect, (node, outs[node])
+
+
+def test_tpurun_multi_node_keras_fit():
+    """Keras fit across two simulated hosts (nnodes 2, np 1 each): the
+    broadcast callback + per-step gradient allreduce ride the shared
+    coordinator across the node boundary (the reference's multi-node
+    mpirun keras story, .travis.yml:93-108 + docs/running.md:15-45)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="",
+               KERAS_BACKEND="jax")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.launcher", "-np", "1",
+             "--cpu", "--nnodes", "2", "--node-rank", str(i),
+             "--coordinator", f"127.0.0.1:{port}",
+             sys.executable, os.path.join(HERE, "keras_worker.py")],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=360)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
