@@ -216,7 +216,9 @@ constexpr int32_t kProtocolVersion = 5;
 // characters loudly and keep the default instead.
 // ---------------------------------------------------------------------------
 
-long long ParseEnvI64(const char* name, long long dflt) {
+long long ParseEnvI64(const char* name, long long dflt,
+                      bool* parsed_ok = nullptr) {
+  if (parsed_ok) *parsed_ok = false;
   const char* v = getenv(name);
   if (!v || !*v) return dflt;
   char* end = nullptr;
@@ -230,6 +232,7 @@ long long ParseEnvI64(const char* name, long long dflt) {
             name, v, dflt);
     return dflt;
   }
+  if (parsed_ok) *parsed_ok = true;
   return out;
 }
 
@@ -526,9 +529,11 @@ void ReducePayload(DType t, RedOp op, std::string* acc, const std::string& in) {
 void ReduceAllStriped(DType t, RedOp op, std::string* acc,
                       const std::vector<Request>& requests) {
   const size_t nbytes = acc->size();
+  static bool env_parsed = false;
   static const long long kThreads = [] {
     long long v = ParseEnvI64("HOROVOD_COORD_REDUCE_THREADS",
-                              std::thread::hardware_concurrency());
+                              std::thread::hardware_concurrency(),
+                              &env_parsed);
     return v < 0 ? 0 : v;
   }();
   const size_t esz = static_cast<size_t>(DTypeSize(t));
@@ -536,19 +541,11 @@ void ReduceAllStriped(DType t, RedOp op, std::string* acc,
   // -bandwidth bound on most hosts. An EXPLICIT override is honored up to
   // 16 (clamped loudly; silent caps hide why raising the knob stops
   // helping).
-  // "Explicit" = set AND parseable: a malformed value falls back to
-  // ParseEnvI64's default (hardware_concurrency) and must then also get
-  // the default 4-stripe cap, or the "using default" warning would lie.
-  static const bool explicit_threads = [] {
-    const char* v = getenv("HOROVOD_COORD_REDUCE_THREADS");
-    if (!v || !*v) return false;
-    char* end = nullptr;
-    errno = 0;
-    strtoll(v, &end, 10);
-    return end != v && *end == '\0' && errno != ERANGE;
-  }();
-  long long want = explicit_threads ? kThreads
-                                    : std::min<long long>(kThreads, 4);
+  // "Explicit" = set AND parseable (parsed_ok from the shared parser): a
+  // malformed value falls back to ParseEnvI64's default
+  // (hardware_concurrency) and must then also get the default 4-stripe
+  // cap, or the "using default" warning would lie.
+  long long want = env_parsed ? kThreads : std::min<long long>(kThreads, 4);
   if (want > 16) {
     static std::atomic<bool> warned{false};
     if (!warned.exchange(true))

@@ -11,6 +11,15 @@ ROOT = os.path.dirname(HERE)
 WORKER = os.path.join(HERE, "launcher_worker.py")
 
 
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def test_tpurun_three_ranks():
     env = dict(os.environ, PYTHONPATH="")
     out = subprocess.run(
@@ -26,12 +35,8 @@ def test_tpurun_multi_node_simulated():
     """Two tpurun invocations with --nnodes 2 (localhost standing in for
     two hosts) must form ONE world of 2 ranks over the shared coordinator
     (the mpirun -H host1,host2 analog)."""
-    import socket
     import re
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = _free_port()
     env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")
     procs = [
         subprocess.Popen(
@@ -75,12 +80,8 @@ def test_tpurun_multi_node_coord_plane_world4():
     --coordinator, must form ONE world of 4 with node-rank arithmetic
     (node r owns global ranks 2r, 2r+1) and complete every public-API
     collective across the "hosts" over the host coordination plane."""
-    import socket
     import re
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = _free_port()
     env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")
     procs = [
         subprocess.Popen(
@@ -107,11 +108,7 @@ def test_tpurun_multi_node_keras_fit():
     broadcast callback + per-step gradient allreduce ride the shared
     coordinator across the node boundary (the reference's multi-node
     mpirun keras story, .travis.yml:93-108 + docs/running.md:15-45)."""
-    import socket
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = _free_port()
     env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="",
                KERAS_BACKEND="jax")
     procs = [
