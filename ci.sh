@@ -14,6 +14,12 @@ make -C horovod_tpu/coord selftest tsan
 echo "== unit + multi-process test suite (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 
+echo "== compat leg: pre-export all_gather_invariant resolution =="
+# The version-matrix stand-in for this single-jax image (README "Version
+# matrix"): force the private-symbol fallback utils/compat.py keeps for
+# older jax and re-run the collective sweeps that depend on it.
+HVD_COMPAT_LEVEL=private python -m pytest tests/test_collectives.py -q
+
 echo "== shrunken examples end-to-end (integration tests) =="
 run_cpu() {
   PYTHONPATH= JAX_PLATFORMS=cpu \

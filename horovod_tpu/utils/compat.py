@@ -1,6 +1,23 @@
-"""JAX version compatibility shims."""
+"""JAX version compatibility shims.
+
+``HVD_COMPAT_LEVEL`` forces the resolution level so CI can exercise the
+older-API code paths under a current jax (``ci.sh`` runs a leg with
+``HVD_COMPAT_LEVEL=private``; see README "Version matrix"):
+
+* unset/``public`` — prefer the public symbol (current jax);
+* ``private`` — skip the public symbol, resolve the pre-export private
+  path (jax versions where ``all_gather_invariant`` existed but was not
+  yet public);
+* ``plain`` — plain ``all_gather`` (pre-VMA jax, where shard_map's
+  ``out_specs=P()`` did not require the invariant marking; under a
+  current VMA-checking jax this level is expected to fail type checks —
+  it exists for running the suite against an actually-old jax install,
+  not for simulation).
+"""
 
 from __future__ import annotations
+
+import os
 
 from jax import lax
 
@@ -10,14 +27,30 @@ def _resolve_all_gather_invariant():
     axis, so ``shard_map(..., out_specs=P())`` type-checks under VMA
     analysis. Public in newer JAX; fall back to the private symbol, then to
     plain ``all_gather`` (pre-VMA versions don't need the distinction)."""
-    fn = getattr(lax, "all_gather_invariant", None)
-    if fn is not None:
-        return fn
-    try:
-        from jax._src.lax.parallel import all_gather_invariant
-        return all_gather_invariant
-    except ImportError:
-        return lax.all_gather
+    level = os.environ.get("HVD_COMPAT_LEVEL", "public")
+    if level not in ("public", "private", "plain"):
+        raise ValueError(
+            f"HVD_COMPAT_LEVEL must be public|private|plain, got {level!r}")
+    if level == "public":
+        fn = getattr(lax, "all_gather_invariant", None)
+        if fn is not None:
+            return fn
+        level = "private"
+    forced_private = os.environ.get("HVD_COMPAT_LEVEL") == "private"
+    if level == "private":
+        try:
+            from jax._src.lax.parallel import all_gather_invariant
+            return all_gather_invariant
+        except ImportError:
+            if forced_private:
+                # A forced level must not silently degrade to `plain` (the
+                # level documented to fail under VMA): fail with the real
+                # signal — this jax dropped the private symbol.
+                raise ImportError(
+                    "HVD_COMPAT_LEVEL=private: this jax has neither a "
+                    "public nor a private all_gather_invariant; the "
+                    "private-path CI leg no longer applies to it")
+    return lax.all_gather
 
 
 all_gather_invariant = _resolve_all_gather_invariant()

@@ -49,6 +49,20 @@ class TestModelShapes:
         out = m.apply(v, x, train=False)
         assert out.shape == (2, 7)
 
+    def test_resnet50_space_to_depth_stem(self):
+        """The s2d stem (MLPerf-style 4x4/s1 conv on the 2x2-folded input)
+        must keep the downstream geometry identical: same logits shape,
+        same feature-map sizes (stem out H/2, then maxpool H/4)."""
+        m = models.resnet50(num_classes=7, dtype=jnp.float32,
+                            stem_space_to_depth=True)
+        x = jnp.ones((2, 64, 64, 3))
+        v = m.init(jax.random.PRNGKey(0), x, train=False)
+        out = m.apply(v, x, train=False)
+        assert out.shape == (2, 7)
+        assert jnp.isfinite(out).all()
+        # Kernel is the 4x4x12 reparametrization of the 7x7x3 stem.
+        assert v["params"]["stem_s2d"]["kernel"].shape == (4, 4, 12, 64)
+
     def test_vgg16(self):
         m = models.vgg16(num_classes=5, dtype=jnp.float32)
         x = jnp.zeros((2, 64, 64, 3))
