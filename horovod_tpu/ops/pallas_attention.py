@@ -68,14 +68,27 @@ def _tile_mask(s, qi, kb, bq, bk):
     return jnp.where(q_pos >= k_pos, s, -1e30)
 
 
-def _scores(q, k, qi, kb, *, causal, sm_scale, bq, bk):
-    """Scaled masked score tile [BQ, BK], shared by forward and both
-    backward kernels so the mask/scale math cannot desynchronize. The
-    matmul stays in the input dtype (bf16 MXU passes with f32
-    accumulation); only diagonal-crossing tiles pay the iota/select mask."""
+# The kernels work in the LOG2 domain: the caller pre-scales q by
+# sm_scale*log2(e) ONCE (a [BH,T,D] pass), so the per-tile [BQ,BK] scale
+# multiply disappears and exp becomes the VPU's native exp2. True scores
+# A = ln2 * s; probabilities exp2(s-m) == exp(A-A_max) are IDENTICAL, and
+# the backward's dq/dk epilogues become *ln2 (ln2 * the caller's c folds
+# back to sm_scale). The kernels are VPU-softmax-bound at D=128 (measured:
+# fwd 41 TF/s vs matmul passes at 157), so per-tile elementwise passes are
+# exactly what to shave.
+_LN2 = 0.6931471805599453
+LOG2E = 1.4426950408889634
+
+
+def _scores(q, k, qi, kb, *, causal, bq, bk):
+    """Masked log2-domain score tile [BQ, BK] (q arrives pre-scaled),
+    shared by forward and both backward kernels so the mask math cannot
+    desynchronize. The matmul stays in the input dtype (bf16 MXU passes
+    with f32 accumulation); only diagonal-crossing tiles pay the
+    iota/select mask."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * sm_scale
+        preferred_element_type=jnp.float32)
     if causal:
         s = jax.lax.cond(
             kb * bk + bk > qi * bq,
@@ -85,12 +98,12 @@ def _scores(q, k, qi, kb, *, causal, sm_scale, bq, bk):
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                 l_ref, *, causal: bool, sm_scale: float, bq: int, bk: int):
+                 l_ref, *, causal: bool, bq: int, bk: int):
     """Grid (bh, qi, kb): one [BQ, D] × [BK, D] tile pair.
 
     K/V tiles stream through VMEM (no whole-sequence residency); the
     online-softmax state (acc/m/l) persists in scratch across the kb axis,
-    and the normalized output plus the row log-sum-exp (saved for the
+    and the normalized output plus the row log2-sum-exp2 (saved for the
     backward pass) are written at the last kb step. Above-diagonal tile
     pairs skip all compute under causal.
     """
@@ -109,13 +122,12 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     @pl.when(run)
     def _compute():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
-        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
-                    bq=bq, bk=bk)                        # [BQ, BK]
+        s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)  # [BQ, BK]
         m_prev = m_ref[:, 0]                             # [BQ]
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = (l_ref[:, 0] * alpha
                     + jnp.sum(p, axis=-1))[:, None] * jnp.ones_like(l_ref)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
@@ -129,17 +141,20 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe[:, None]).astype(o_ref.dtype)
         if lse_ref is not None:
-            lse = jnp.where(l == 0.0, -1e30, m_ref[:, 0] + jnp.log(safe))
+            # log2 domain, matching the backward's exp2 recompute.
+            lse = jnp.where(l == 0.0, -1e30, m_ref[:, 0] + jnp.log2(safe))
             lse_ref[0] = lse[:, None] * jnp.ones_like(lse_ref[0])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, causal: bool, sm_scale: float, bq: int, bk: int):
+               acc_ref, *, causal: bool, bq: int, bk: int):
     """Grid (bh, qi, kb): accumulate dq over the kb axis.
 
     Recomputes the probability tile from (q, k, lse) — the flash-backward
     trade: [BQ, BK] tiles never leave VMEM.
-    dS = P ∘ (dO·Vᵀ − Δ), dQ = sm_scale · dS·K, Δ = rowsum(dO ∘ O).
+    dA = P ∘ (dO·Vᵀ − Δ), dQ_scaled = ln2 · dA·K (q arrives pre-scaled;
+    ln2 · the caller's log2e·sm_scale prescale folds back to the true
+    sm_scale chain rule), Δ = rowsum(dO ∘ O).
     """
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -154,9 +169,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
-                    bq=bq, bk=bk)
-        p = jnp.exp(s - lse_ref[0][:, :1])               # [BQ, BK]
+        s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)
+        p = jnp.exp2(s - lse_ref[0][:, :1])              # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
@@ -166,14 +180,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(kb == n_kb - 1)
     def _finish():
-        dq_ref[0] = (acc_ref[:] * sm_scale).astype(dq_ref.dtype)
+        dq_ref[0] = (acc_ref[:] * _LN2).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_acc, dv_acc, *, causal: bool, sm_scale: float,
+                dv_ref, dk_acc, dv_acc, *, causal: bool,
                 bq: int, bk: int):
     """Grid (bh, kb, qi): accumulate dk/dv for one K/V tile over all
-    contributing Q tiles. dV = Pᵀ·dO; dK = sm_scale · dSᵀ·Q."""
+    contributing Q tiles. dV = Pᵀ·dO; dK_true = ln2 · dAᵀ·Q_scaled (the
+    prescale on q makes ln2 the correct chain factor for k too)."""
     kb = pl.program_id(1)
     qi = pl.program_id(2)
     n_qi = pl.num_programs(2)
@@ -188,9 +203,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = _scores(q, k, qi, kb, causal=causal, sm_scale=sm_scale,
-                    bq=bq, bk=bk)
-        p = jnp.exp(s - lse_ref[0][:, :1])               # [BQ, BK]
+        s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)
+        p = jnp.exp2(s - lse_ref[0][:, :1])              # [BQ, BK]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # Pᵀ·dO [BK, D]
@@ -199,11 +213,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # dSᵀ·Q [BK, D]
+            preferred_element_type=jnp.float32)          # dAᵀ·Q [BK, D]
 
     @pl.when(qi == n_qi - 1)
     def _finish():
-        dk_ref[0] = (dk_acc[:] * sm_scale).astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * _LN2).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -213,9 +227,10 @@ def _row_spec(block_rows, which):
     return pl.BlockSpec((1, block_rows, 128), which)
 
 
-def _fwd_pallas(q, k, v, causal: bool, sm_scale: float, interpret: bool,
+def _fwd_pallas(q, k, v, causal: bool, interpret: bool,
                 with_lse: bool = True):
-    """q/k/v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, 128] f32 | None).
+    """q/k/v: [BH, T, D], q PRE-SCALED by sm_scale*log2e ->
+    (o [BH, T, D], lse2 [BH, T, 128] f32 | None).
 
     ``with_lse=False`` (the no-grad primal) drops the lse output — Mosaic
     can't dead-code-eliminate an output buffer, and at long T the f32 lse
@@ -224,8 +239,7 @@ def _fwd_pallas(q, k, v, causal: bool, sm_scale: float, interpret: bool,
     bq = _pick_block(T, _WANT_BQ)
     bk = _pick_block(T, _WANT_BK)
     grid = (BH, T // bq, T // bk)
-    base = functools.partial(_attn_kernel, causal=causal,
-                             sm_scale=sm_scale, bq=bq, bk=bk)
+    base = functools.partial(_attn_kernel, causal=causal, bq=bq, bk=bk)
     if with_lse:
         kernel = base
         out_specs = [
@@ -262,14 +276,17 @@ def _fwd_pallas(q, k, v, causal: bool, sm_scale: float, interpret: bool,
     return (out if with_lse else (out, None))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, causal: bool, sm_scale: float, interpret: bool):
-    o, _ = _fwd_pallas(q, k, v, causal, sm_scale, interpret, with_lse=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, causal: bool, interpret: bool):
+    """q arrives pre-scaled by sm_scale*log2e (see _flash_bhtd); the VJP
+    therefore returns dq in the SCALED domain and jax's chain rule through
+    the caller's multiply restores the true dq."""
+    o, _ = _fwd_pallas(q, k, v, causal, interpret, with_lse=False)
     return o
 
 
-def _flash_core_fwd(q, k, v, causal, sm_scale, interpret):
-    o, lse = _fwd_pallas(q, k, v, causal, sm_scale, interpret)
+def _flash_core_fwd(q, k, v, causal, interpret):
+    o, lse = _fwd_pallas(q, k, v, causal, interpret)
     # Keep only one lane of the lane-replicated [BH, T, 128] lse in the
     # residuals: the full copy is 128x the statistic and would sit in HBM
     # from forward to backward of every layer (~134 MB/layer at the bench
@@ -277,7 +294,7 @@ def _flash_core_fwd(q, k, v, causal, sm_scale, interpret):
     return o, (q, k, v, o, lse[..., :1])
 
 
-def _flash_core_bwd(causal, sm_scale, interpret, res, do):
+def _flash_core_bwd(causal, interpret, res, do):
     q, k, v, o, lse = res
     BH, T, D = q.shape
     bq = _pick_block(T, _WANT_BQ)
@@ -291,8 +308,7 @@ def _flash_core_bwd(causal, sm_scale, interpret, res, do):
     qkv_spec_q = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
     qkv_spec_k = pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
-                          bq=bq, bk=bk),
+        functools.partial(_dq_kernel, causal=causal, bq=bq, bk=bk),
         grid=(BH, T // bq, T // bk),
         in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
                   _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0)),
@@ -308,8 +324,7 @@ def _flash_core_bwd(causal, sm_scale, interpret, res, do):
     kv_q = pl.BlockSpec((1, bq, D), lambda bh, kb, qi: (bh, qi, 0))
     kv_k = pl.BlockSpec((1, bk, D), lambda bh, kb, qi: (bh, kb, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
-                          bq=bq, bk=bk),
+        functools.partial(_dkv_kernel, causal=causal, bq=bq, bk=bk),
         grid=(BH, T // bk, T // bq),
         in_specs=[kv_q, kv_k, kv_k, kv_q,
                   _row_spec(bq, lambda bh, kb, qi: (bh, qi, 0)),
@@ -338,8 +353,12 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 def _flash_bhtd(q, k, v, causal: bool, sm_scale: float, interpret: bool):
     """q/k/v: [BH, T, D] -> [BH, T, D]. Differentiable (custom VJP with
     Pallas dq/dkv kernels — the score matrix never touches HBM in either
-    direction)."""
-    return _flash_core(q, k, v, causal, sm_scale, interpret)
+    direction). q is pre-scaled here (one cheap [BH,T,D] pass) so the
+    kernels run scale-free in the log2 domain; jax's chain rule through
+    this multiply restores the true dq from the kernel's scaled-domain
+    output."""
+    q = (q.astype(jnp.float32) * (sm_scale * LOG2E)).astype(q.dtype)
+    return _flash_core(q, k, v, causal, interpret)
 
 
 # Above roughly this many bytes of [B, H, T, T] f32 scores, the dense XLA

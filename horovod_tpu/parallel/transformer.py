@@ -49,6 +49,14 @@ class TransformerConfig:
     # on the fast MXU path (f32 accumulation either way); logits and the
     # softmax stay f32.
     unembed_dtype: Any = jnp.float32
+    # >0: compute the LM cross-entropy in vocab chunks of this width with
+    # an online log-sum-exp, never materializing the [B, T, vocab] f32
+    # logits (2.1 GB at the bench config — the tensor that capped the
+    # bench batch at 8). The chunk body is jax.checkpoint'd, so backward
+    # recomputes each chunk's logits instead of saving them: ~+1 unembed
+    # matmul of FLOPs for O(vocab/chunk) less live memory. Must divide
+    # vocab. 0 = dense log_softmax (reference-style).
+    loss_chunk: int = 0
 
 
 def _axes(mesh: Mesh):
@@ -129,9 +137,12 @@ def _rms_norm(x, scale):
     return ((x32 / rms) * scale).astype(x.dtype)
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
+def forward_hidden(params, tokens, cfg: TransformerConfig, mesh: Mesh):
     """Runs INSIDE shard_map: ``tokens`` [B_local, T_local] int32.
-    Returns (logits [B_local, T_local, vocab], moe_aux_loss)."""
+    Returns (final hidden states [B_local, T_local, d_model] — the
+    pre-unembed activations — and the MoE aux loss). The chunked-loss
+    path consumes this directly so the [*, vocab] logits never
+    materialize; :func:`forward` layers the tied unembed on top."""
     axes = _axes(mesh)
     has_tp = "tp" in axes
     has_sp = "sp" in axes
@@ -193,12 +204,68 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["lnf"])
+    return x, aux_total
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
+    """Full forward: hidden states through the tied unembed.
+    Returns (logits [B_local, T_local, vocab], moe_aux_loss)."""
+    x, aux_total = forward_hidden(params, tokens, cfg, mesh)
     # Tied head: bf16 MXU pass with f32 accumulation when unembed_dtype is
     # bf16; logits are f32 either way for a stable softmax.
     logits = jnp.matmul(x.astype(cfg.unembed_dtype),
                         params["embed"].T.astype(cfg.unembed_dtype),
                         preferred_element_type=jnp.float32)
     return logits, aux_total
+
+
+def chunked_nll(x, embed, labels, cfg: TransformerConfig):
+    """Per-token −log p(label) over a tied unembedding, computed in vocab
+    chunks with an online log-sum-exp so the [N, vocab] f32 logits never
+    exist at once (the memory-bound tensor of LM training; the same
+    running max/sum recurrence flash attention uses, applied to the loss).
+
+    The chunk body is ``jax.checkpoint``'d: autodiff through the scan
+    would otherwise stash every chunk's logits — the full logits tensor
+    again — as residuals; with remat, backward replays each chunk's
+    unembed matmul instead (one extra [N, d] × [d, C] pass per chunk).
+    """
+    orig_shape = x.shape[:-1]
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)
+    lab = labels.reshape(-1)
+    n = xf.shape[0]
+    vocab = embed.shape[0]
+    chunk = cfg.loss_chunk
+    if vocab % chunk:
+        raise ValueError(
+            f"loss_chunk={chunk} must divide vocab={vocab}")
+    n_chunks = vocab // chunk
+    wch = embed.reshape(n_chunks, chunk, d)
+
+    def body(carry, inp):
+        m, s, ll = carry
+        i, w = inp
+        logits = jnp.matmul(xf.astype(cfg.unembed_dtype),
+                            w.T.astype(cfg.unembed_dtype),
+                            preferred_element_type=jnp.float32)  # [N, C]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
+        off = i * chunk
+        in_chunk = (lab >= off) & (lab < off + chunk)
+        idx = jnp.clip(lab - off, 0, chunk - 1)
+        picked = jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0]
+        ll = ll + jnp.where(in_chunk, picked, 0.0)
+        return (m_new, s, ll), None
+
+    init = (jnp.full((n,), -1e30, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, ll), _ = lax.scan(jax.checkpoint(body), init,
+                             (jnp.arange(n_chunks), wch))
+    lse = m + jnp.log(s)
+    return (lse - ll).reshape(orig_shape)
 
 
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
@@ -233,9 +300,13 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
         return grad_sync_by_spec(grads, specs, axes)
 
     def _loss_fn(params, tokens, labels):
-        logits, aux = forward(params, tokens, cfg, mesh)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
+        if cfg.loss_chunk:
+            x, aux = forward_hidden(params, tokens, cfg, mesh)
+            nll = chunked_nll(x, params["embed"], labels, cfg)
+        else:
+            logits, aux = forward(params, tokens, cfg, mesh)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)
         loss = jnp.mean(nll) + aux_weight * aux
         return loss
 

@@ -537,3 +537,46 @@ class TestParallelTransformer:
             losses.append(float(loss))
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
+
+
+class TestChunkedLoss:
+    """loss_chunk: the online chunked cross-entropy must match the dense
+    log_softmax path exactly — loss value AND one full train step's
+    resulting params — while never materializing [*, vocab] logits."""
+
+    CFG = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               dtype=jnp.float32, unembed_dtype=jnp.float32,
+               attn_backend="xla")
+
+    def _one_step(self, loss_chunk):
+        from horovod_tpu.parallel.transformer import (
+            TransformerConfig, make_parallel_train_step)
+        from jax.sharding import Mesh
+        cfg = TransformerConfig(**self.CFG, loss_chunk=loss_chunk)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        init_state, step = make_parallel_train_step(
+            cfg, mesh, optax.sgd(0.1))
+        params, opt_state = init_state(jax.random.PRNGKey(3))
+        rng = np.random.RandomState(1)
+        tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+        labels = jnp.roll(tokens, -1, axis=1)
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        return float(loss), jax.tree_util.tree_map(np.asarray, params)
+
+    def test_matches_dense_loss_and_step(self):
+        dense_loss, dense_params = self._one_step(0)
+        for chunk in (16, 32, 64):
+            c_loss, c_params = self._one_step(chunk)
+            np.testing.assert_allclose(c_loss, dense_loss, rtol=1e-5,
+                                       atol=1e-6, err_msg=f"chunk={chunk}")
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=2e-5, atol=2e-6), c_params, dense_params)
+
+    def test_chunk_must_divide_vocab(self):
+        from horovod_tpu.parallel.transformer import (
+            TransformerConfig, chunked_nll)
+        cfg = TransformerConfig(**self.CFG, loss_chunk=48)
+        with pytest.raises(ValueError, match="divide vocab"):
+            chunked_nll(jnp.zeros((2, 4, 32)), jnp.zeros((64, 32)),
+                        jnp.zeros((2, 4), jnp.int32), cfg)
