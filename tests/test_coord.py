@@ -635,7 +635,16 @@ def test_ring_broadcast_rank_death_mid_chain():
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=120)[0] for p in procs]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120)[0])
+    except subprocess.TimeoutExpired:
+        # The regression this test guards against IS a hang: reap the
+        # survivors instead of leaking them into the rest of the suite.
+        for q in procs:
+            q.kill()
+        raise
     assert procs[1].returncode == 17
     for rank in (0, 2):
         assert "TRANSPORT_ERROR" in outs[rank], (rank, outs[rank])
@@ -1086,3 +1095,60 @@ def test_short_payload_rejected_with_named_error():
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0, f"rank {rank}:\n{out}"
         assert f"rank {rank}: SHORT_REJECTED" in out
+
+
+def test_rank_death_mid_mesh_alltoall_propagates_transport_error():
+    """A rank dying while a MESH alltoall is in flight must degrade to
+    TransportError on the survivors (peer sockets cascade EOF), same
+    guarantee as the ring paths."""
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+        from horovod_tpu.exceptions import TransportError
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 3, "127.0.0.1", {port})
+        # Establish the peer mesh with a first successful alltoall.
+        n = 3 * 65536
+        ok = np.asarray(c.collective(
+            "alltoall", np.full(n, float(rank), np.float32), "ok.a2a"))
+        assert ok.shape == (n,)
+        # Doomed op far larger than socket buffers so every survivor's
+        # pairwise exchange with the dead rank must fail.
+        big = np.full(3 << 22, float(rank), np.float32)  # 48 MiB
+        if rank == 1:
+            c.submit("alltoall", big, "doomed.a2a")
+            os._exit(17)
+        try:
+            c.collective("alltoall", big, "doomed.a2a")
+            print(f"rank {{rank}}: NO ERROR", flush=True)
+        except TransportError:
+            print(f"rank {{rank}}: TRANSPORT_ERROR", flush=True)
+        c.shutdown()
+    """)
+    procs = []
+    for rank in range(3):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu",
+                   HOROVOD_RING_THRESHOLD="65536",
+                   HOROVOD_RING_IO_TIMEOUT="3")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120)[0])
+    except subprocess.TimeoutExpired:
+        # The regression this test guards against IS a hang: reap the
+        # survivors instead of leaking them into the rest of the suite.
+        for q in procs:
+            q.kill()
+        raise
+    assert procs[1].returncode == 17
+    for rank in (0, 2):
+        assert "TRANSPORT_ERROR" in outs[rank], (rank, outs[rank])
