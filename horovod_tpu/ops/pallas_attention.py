@@ -226,16 +226,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+# Lane width of the per-row stat tensors (lse, delta) on the wire between
+# kernels. Only lane 0 carries data; 8 lanes (one f32 sublane tile) keeps
+# Mosaic layouts happy while cutting the streamed stat traffic 16x vs the
+# old 128-lane replication: at the bench config BH = B*H = 8*16 = 128,
+# T = 2048, so a 128-lane f32 stat was 128*2048*128*4 = 134 MB per stat
+# per kernel per layer — pure HBM burn for a [BH, T] statistic.
+_STAT_LANES = 8
+
+
 def _row_spec(block_rows, which):
-    """BlockSpec for per-row stats [BH, T, 128]: the stats column is
-    replicated across the 128 lanes so tiles stay MXU/VPU-shaped."""
-    return pl.BlockSpec((1, block_rows, 128), which)
+    """BlockSpec for per-row stats [BH, T, _STAT_LANES]; kernels read
+    column 0 only."""
+    return pl.BlockSpec((1, block_rows, _STAT_LANES), which)
 
 
 def _fwd_pallas(q, k, v, causal: bool, interpret: bool,
                 with_lse: bool = True):
     """q/k/v: [BH, T, D], q PRE-SCALED by sm_scale*log2e ->
-    (o [BH, T, D], lse2 [BH, T, 128] f32 | None).
+    (o [BH, T, D], lse2 [BH, T, _STAT_LANES] f32 | None).
 
     ``with_lse=False`` (the no-grad primal) drops the lse output — Mosaic
     can't dead-code-eliminate an output buffer, and at long T the f32 lse
@@ -253,7 +262,7 @@ def _fwd_pallas(q, k, v, causal: bool, interpret: bool,
         ]
         out_shape = [
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T, 128), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, _STAT_LANES), jnp.float32),
         ]
     else:
         def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
@@ -292,11 +301,10 @@ def _flash_core(q, k, v, causal: bool, interpret: bool):
 
 def _flash_core_fwd(q, k, v, causal, interpret):
     o, lse = _fwd_pallas(q, k, v, causal, interpret)
-    # Keep only one lane of the lane-replicated [BH, T, 128] lse in the
-    # residuals: the full copy is 128x the statistic and would sit in HBM
-    # from forward to backward of every layer (~134 MB/layer at the bench
-    # config). The backward pass re-broadcasts it like delta.
-    return o, (q, k, v, o, lse[..., :1])
+    # lse is already the narrow [BH, T, _STAT_LANES] wire format; keep it
+    # whole in the residuals (slicing to one lane and re-broadcasting in
+    # backward would cost two device copies to save 7 f32 lanes).
+    return o, (q, k, v, o, lse)
 
 
 def _flash_core_bwd(causal, interpret, res, do):
@@ -304,12 +312,11 @@ def _flash_core_bwd(causal, interpret, res, do):
     BH, T, D = q.shape
     bq = _pick_block(T, _WANT_BQ)
     bk = _pick_block(T, _WANT_BK)
-    lse = jnp.broadcast_to(lse, (BH, T, 128))            # re-lane-replicate
     # Δ_i = Σ_d dO ∘ O — cheap elementwise reduction, XLA fuses it;
-    # replicated across lanes like lse so the kernels read [BQ, 128] tiles.
+    # widened to _STAT_LANES like lse so the kernels read [BQ, 8] tiles.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)              # [BH, T, 1]
-    delta = jnp.broadcast_to(delta, (BH, T, 128))
+    delta = jnp.broadcast_to(delta, (BH, T, _STAT_LANES))
     qkv_spec_q = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
     qkv_spec_k = pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0))
     dq = pl.pallas_call(
