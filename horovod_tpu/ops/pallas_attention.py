@@ -98,18 +98,24 @@ def _scores(q, k, qi, kb, *, causal, bq, bk):
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                 l_ref, *, causal: bool, bq: int, bk: int):
-    """Grid (bh, qi, kb): one [BQ, D] × [BK, D] tile pair.
+                 l_ref, *, causal: bool, bq: int, bk: int,
+                 qi_axis: int = 1, kb_axis: int = 2,
+                 q_scale: Optional[float] = None):
+    """Grid (..., qi, kb): one [BQ, D] × [BK, D] tile pair.
 
     K/V tiles stream through VMEM (no whole-sequence residency); the
     online-softmax state (acc/m/l) persists in scratch across the kb axis,
     and the normalized output plus the row log2-sum-exp2 (saved for the
     backward pass) are written at the last kb step. Above-diagonal tile
     pairs skip all compute under causal.
+
+    ``q_scale``: the packed-qkv path ships RAW q tiles and scales them on
+    load (a [BQ,D] pass) instead of pre-scaling the whole tensor; None =
+    q already pre-scaled by the caller (the split-q/k/v path).
     """
-    qi = pl.program_id(1)
-    kb = pl.program_id(2)
-    n_kb = pl.num_programs(2)
+    qi = pl.program_id(qi_axis)
+    kb = pl.program_id(kb_axis)
+    n_kb = pl.num_programs(kb_axis)
 
     @pl.when(kb == 0)
     def _init():
@@ -122,6 +128,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
     @pl.when(run)
     def _compute():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        if q_scale is not None:
+            q = (q.astype(jnp.float32) * q_scale).astype(q_ref.dtype)
         s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)  # [BQ, BK]
         m_prev = m_ref[:, 0]                             # [BQ]
         m_blk = jnp.max(s, axis=-1)
@@ -152,18 +160,22 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, causal: bool, bq: int, bk: int):
-    """Grid (bh, qi, kb): accumulate dq over the kb axis.
+               acc_ref, *, causal: bool, bq: int, bk: int,
+               qi_axis: int = 1, kb_axis: int = 2,
+               q_scale: Optional[float] = None,
+               dq_scale: float = _LN2):
+    """Grid (..., qi, kb): accumulate dq over the kb axis.
 
     Recomputes the probability tile from (q, k, lse) — the flash-backward
     trade: [BQ, BK] tiles never leave VMEM.
-    dA = P ∘ (dO·Vᵀ − Δ), dQ_scaled = ln2 · dA·K (q arrives pre-scaled;
-    ln2 · the caller's log2e·sm_scale prescale folds back to the true
-    sm_scale chain rule), Δ = rowsum(dO ∘ O).
+    dA = P ∘ (dO·Vᵀ − Δ), Δ = rowsum(dO ∘ O). Split path: q arrives
+    pre-scaled, dq_scale = ln2 (the caller's log2e·sm_scale prescale folds
+    the chain rule back to sm_scale). Packed path: q raw + q_scale set,
+    dq_scale = sm_scale directly.
     """
-    qi = pl.program_id(1)
-    kb = pl.program_id(2)
-    n_kb = pl.num_programs(2)
+    qi = pl.program_id(qi_axis)
+    kb = pl.program_id(kb_axis)
+    n_kb = pl.num_programs(kb_axis)
 
     @pl.when(kb == 0)
     def _init():
@@ -174,6 +186,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        if q_scale is not None:
+            q = (q.astype(jnp.float32) * q_scale).astype(q_ref.dtype)
         s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)
         p = jnp.exp2(s - lse_ref[0][:, :1])              # [BQ, BK]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -185,18 +199,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(kb == n_kb - 1)
     def _finish():
-        dq_ref[0] = (acc_ref[:] * _LN2).astype(dq_ref.dtype)
+        dq_ref[0] = (acc_ref[:] * dq_scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, dk_acc, dv_acc, *, causal: bool,
-                bq: int, bk: int):
-    """Grid (bh, kb, qi): accumulate dk/dv for one K/V tile over all
-    contributing Q tiles. dV = Pᵀ·dO; dK_true = ln2 · dAᵀ·Q_scaled (the
-    prescale on q makes ln2 the correct chain factor for k too)."""
-    kb = pl.program_id(1)
-    qi = pl.program_id(2)
-    n_qi = pl.num_programs(2)
+                bq: int, bk: int, kb_axis: int = 1, qi_axis: int = 2,
+                q_scale: Optional[float] = None,
+                dk_scale: float = _LN2):
+    """Grid (..., kb, qi): accumulate dk/dv for one K/V tile over all
+    contributing Q tiles. dV = Pᵀ·dO. Split path: dK = ln2 · dAᵀ·Q_scaled
+    (prescaled q makes ln2 the correct chain factor). Packed path: q raw
+    (scaled only for the score recompute), dK = sm_scale · dAᵀ·Q."""
+    kb = pl.program_id(kb_axis)
+    qi = pl.program_id(qi_axis)
+    n_qi = pl.num_programs(qi_axis)
 
     @pl.when(qi == 0)
     def _init():
@@ -208,7 +225,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     @pl.when(run)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        s = _scores(q, k, qi, kb, causal=causal, bq=bq, bk=bk)
+        qs = q
+        if q_scale is not None:
+            qs = (q.astype(jnp.float32) * q_scale).astype(q_ref.dtype)
+        s = _scores(qs, k, qi, kb, causal=causal, bq=bq, bk=bk)
         p = jnp.exp2(s - lse_ref[0][:, :1])              # [BQ, BK]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -222,7 +242,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
     @pl.when(qi == n_qi - 1)
     def _finish():
-        dk_ref[0] = (dk_acc[:] * _LN2).astype(dk_ref.dtype)
+        dk_ref[0] = (dk_acc[:] * dk_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -360,6 +380,188 @@ def _flash_core_bwd(causal, interpret, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Packed-qkv path: consume the fused QKV projection output [B, T, H*3*D]
+# (HEAD-major columns, i.e. reshape [B, T, H, 3, D]) DIRECTLY via BlockSpec
+# index maps — no [B,T,H,D] -> [BH,T,D] transposes on either side of the
+# kernels (measured ~11 ms/step of layout copies at the LM bench config).
+# The attention output comes back as [B, T, H*D], exactly what the output
+# projection consumes. q is scaled inside the kernels (a [BQ,D] pass).
+# ---------------------------------------------------------------------------
+
+
+def _qkv_specs(H, D, bq, bk):
+    """BlockSpecs into the packed [B, T, H*3*D] array for grid
+    (B, H, qi, kb): column block (h*3 + kind) is head h's q/k/v slice."""
+    q = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h * 3 + 0))
+    k = pl.BlockSpec((1, bk, D), lambda b, h, qi, kb: (b, kb, h * 3 + 1))
+    v = pl.BlockSpec((1, bk, D), lambda b, h, qi, kb: (b, kb, h * 3 + 2))
+    return q, k, v
+
+
+def _fwd_pallas_qkv(qkv, H, D, causal, sm_scale, interpret,
+                    with_lse=True):
+    B, T, _ = qkv.shape
+    bq = _pick_block(T, _WANT_BQ)
+    bk = _pick_block(T, _WANT_BK)
+    grid = (B, H, T // bq, T // bk)
+    c = sm_scale * LOG2E
+    base = functools.partial(_attn_kernel, causal=causal, bq=bq, bk=bk,
+                             qi_axis=2, kb_axis=3, q_scale=c)
+    sq, sk, sv = _qkv_specs(H, D, bq, bk)
+    o_spec = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
+    # Stats shaped [B*H, T, S]: index maps may do arithmetic on grid ids.
+    stat_spec = pl.BlockSpec((1, bq, _STAT_LANES),
+                             lambda b, h, qi, kb: (b * H + h, qi, 0))
+    if with_lse:
+        kernel = base
+        out_specs = [o_spec, stat_spec]
+        out_shape = [
+            jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B * H, T, _STAT_LANES), jnp.float32),
+        ]
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+            base(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref)
+        out_specs = o_spec
+        out_shape = jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[sq, sk, sv],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=_grid_params(
+            ("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+    return (out if with_lse else (out, None))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash_qkv_core(qkv, H: int, causal: bool, sm_scale: float,
+                    interpret: bool):
+    D = qkv.shape[-1] // (3 * H)
+    o, _ = _fwd_pallas_qkv(qkv, H, D, causal, sm_scale, interpret,
+                           with_lse=False)
+    return o
+
+
+def _flash_qkv_core_fwd(qkv, H, causal, sm_scale, interpret):
+    D = qkv.shape[-1] // (3 * H)
+    o, lse = _fwd_pallas_qkv(qkv, H, D, causal, sm_scale, interpret)
+    return o, (qkv, o, lse)
+
+
+def _flash_qkv_core_bwd(H, causal, sm_scale, interpret, res, do):
+    qkv, o, lse = res
+    B, T, _ = qkv.shape
+    D = qkv.shape[-1] // (3 * H)
+    bq = _pick_block(T, _WANT_BQ)
+    bk = _pick_block(T, _WANT_BK)
+    c = sm_scale * LOG2E
+    delta = jnp.sum(
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+            B, T, H, D),
+        axis=-1)                                        # [B, T, H]
+    delta = jnp.broadcast_to(
+        delta.transpose(0, 2, 1).reshape(B * H, T, 1),
+        (B * H, T, _STAT_LANES))
+    sq, sk, sv = _qkv_specs(H, D, bq, bk)
+    do_q = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
+    stat_q = pl.BlockSpec((1, bq, _STAT_LANES),
+                          lambda b, h, qi, kb: (b * H + h, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, bq=bq, bk=bk,
+                          qi_axis=2, kb_axis=3, q_scale=c,
+                          dq_scale=sm_scale),
+        grid=(B, H, T // bq, T // bk),
+        in_specs=[sq, sk, sv, do_q, stat_q, stat_q],
+        out_specs=pl.BlockSpec((1, bq, D),
+                               lambda b, h, qi, kb: (b, qi, h)),
+        out_shape=jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_grid_params(
+            ("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do, lse, delta)
+
+    # dk/dv iterate the OTHER way: grid (B, H, kb, qi).
+    kv_sq = pl.BlockSpec((1, bq, D), lambda b, h, kb, qi: (b, qi, h * 3))
+    kv_sk = pl.BlockSpec((1, bk, D),
+                         lambda b, h, kb, qi: (b, kb, h * 3 + 1))
+    kv_sv = pl.BlockSpec((1, bk, D),
+                         lambda b, h, kb, qi: (b, kb, h * 3 + 2))
+    kv_do = pl.BlockSpec((1, bq, D), lambda b, h, kb, qi: (b, qi, h))
+    kv_stat = pl.BlockSpec((1, bq, _STAT_LANES),
+                           lambda b, h, kb, qi: (b * H + h, qi, 0))
+    kv_out = pl.BlockSpec((1, bk, D), lambda b, h, kb, qi: (b, kb, h))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, bq=bq, bk=bk,
+                          kb_axis=2, qi_axis=3, q_scale=c,
+                          dk_scale=sm_scale),
+        grid=(B, H, T // bk, T // bq),
+        in_specs=[kv_sq, kv_sk, kv_sv, kv_do, kv_stat, kv_stat],
+        out_specs=[kv_out, kv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=_grid_params(
+            ("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qkv, qkv, qkv, do, lse, delta)
+    # Interleave back into the packed head-major (H, 3, D) column layout.
+    d_qkv = jnp.stack(
+        [g.reshape(B, T, H, D) for g in (dq, dk, dv)],
+        axis=3).reshape(B, T, H * 3 * D)
+    return (d_qkv,)
+
+
+_flash_qkv_core.defvjp(_flash_qkv_core_fwd, _flash_qkv_core_bwd)
+
+
+def qkv_flash_tilable(T: int, d_head: int) -> bool:
+    """Whether the packed-qkv kernel path tiles these dims."""
+    return (_HAS_PALLAS and T % BLOCK_Q == 0 and T % BLOCK_K == 0
+            and d_head % 128 == 0)
+
+
+def flash_attention_qkv(qkv, n_heads: int, *, causal: bool = False,
+                        sm_scale: Optional[float] = None,
+                        interpret: Optional[bool] = None):
+    """Attention straight from the packed QKV projection output.
+
+    Args:
+      qkv: [B, T, n_heads*3*d_head], HEAD-major columns (i.e. reshapes to
+        [B, T, n_heads, 3, d_head] — the layout the parallel transformer's
+        fused projection produces).
+      n_heads: head count (d_head inferred).
+    Returns: [B, T, n_heads*d_head] attention output, ready for the output
+    projection. Differentiable (custom VJP; dq/dk/dv re-interleave into
+    the packed gradient). Requires ``qkv_flash_tilable``; callers fall
+    back to the split path otherwise.
+    """
+    B, T, cols = qkv.shape
+    D = cols // (3 * n_heads)
+    if sm_scale is None:
+        sm_scale = float(D) ** -0.5
+    if not qkv_flash_tilable(T, D):
+        raise ValueError(
+            f"flash_attention_qkv needs T%128==0 and d_head%128==0; got "
+            f"T={T}, d_head={D} (use the split flash_attention fallback)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_qkv_core(qkv, n_heads, causal, sm_scale, interpret)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
                                              "interpret"))
 def _flash_bhtd(q, k, v, causal: bool, sm_scale: float, interpret: bool):
@@ -412,8 +614,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     B, T, H, D = q.shape
     if sm_scale is None:
         sm_scale = float(D) ** -0.5
-    tilable = (_HAS_PALLAS and T % BLOCK_Q == 0 and T % BLOCK_K == 0
-               and D % 128 == 0)
+    tilable = qkv_flash_tilable(T, D)
     if backend == "auto":
         score_bytes = 4 * B * H * T * T
         backend = "pallas" if (tilable

@@ -151,6 +151,9 @@ def forward_hidden(params, tokens, cfg: TransformerConfig, mesh: Mesh):
     d_head = cfg.d_model // cfg.n_heads
 
     def _layer_fwd(layer, x):
+        from ..ops.pallas_attention import (flash_attention,
+                                            flash_attention_qkv,
+                                            qkv_flash_tilable)
         h = _rms_norm(x, layer["ln1"])
         qkv = h @ layer["wqkv"].astype(cfg.dtype)     # [B, T, 3·D/tp]
         B, T, _ = qkv.shape
@@ -158,24 +161,34 @@ def forward_hidden(params, tokens, cfg: TransformerConfig, mesh: Mesh):
         # whole heads (each with its own q,k,v), so the sharded model
         # computes the SAME function as tp=1 from the same weights
         # (checkpoints stay portable across mesh shapes).
-        qkv = qkv.reshape(B, T, n_heads_local, 3, d_head)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        if has_sp:
-            attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+        if (not has_sp and cfg.attn_backend == "pallas"
+                and qkv_flash_tilable(T, d_head)):
+            # Packed path: the kernel consumes the projection output
+            # directly (head-major columns) and returns [B, T, H·dh] — no
+            # [B,T,H,dh] <-> [BH,T,dh] transposes on either side
+            # (~11 ms/step of layout copies at the LM bench config).
+            attn = flash_attention_qkv(qkv, n_heads_local,
+                                       causal=True).astype(cfg.dtype)
         else:
-            # Single-shard attention: the Pallas blockwise kernel by
-            # default (scores never hit HBM in forward OR backward);
-            # untilable shapes fall back to XLA dense inside.
-            from ..ops.pallas_attention import flash_attention
-            attn = flash_attention(q, k, v, causal=True,
-                                   backend=cfg.attn_backend
-                                   ).astype(cfg.dtype)
-        attn = attn.reshape(B, T, n_heads_local * d_head)
+            qkv = qkv.reshape(B, T, n_heads_local, 3, d_head)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            if has_sp:
+                attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+            else:
+                # Single-shard attention: the Pallas blockwise kernel by
+                # default (scores never hit HBM in forward OR backward);
+                # untilable shapes fall back to XLA dense inside.
+                attn = flash_attention(q, k, v, causal=True,
+                                       backend=cfg.attn_backend
+                                       ).astype(cfg.dtype)
+            attn = attn.reshape(B, T, n_heads_local * d_head)
         proj = attn @ layer["wo"].astype(cfg.dtype)
         if has_tp:
             proj = lax.psum(proj, "tp")               # row-parallel combine
         x = x + proj
+        return _ffn(layer, x, B, T)
 
+    def _ffn(layer, x, B, T):
         h = _rms_norm(x, layer["ln2"])
         if has_ep and cfg.n_experts:
             flat = h.reshape(-1, cfg.d_model)

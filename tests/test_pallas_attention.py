@@ -99,3 +99,48 @@ def test_flash_onchip_numerics_at_bench_config():
     if "PALLAS_ONCHIP_SKIP" in out.stdout:
         pytest.skip("no TPU visible to the subprocess")
     assert "PALLAS_ONCHIP_OK" in out.stdout, out.stdout + out.stderr
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_qkv_packed_matches_split(causal):
+    """The packed-qkv entry point (kernel consumes the fused projection
+    output directly, no layout transposes) must match the split q/k/v
+    path exactly — forward and the full packed gradient."""
+    from horovod_tpu.ops.pallas_attention import flash_attention_qkv
+
+    B, T, H, D = 1, 256, 2, 128
+    rng = np.random.RandomState(4)
+    qkv = jnp.asarray(rng.randn(B, T, H * 3 * D), jnp.float32) * 0.5
+    r = qkv.reshape(B, T, H, 3, D)
+    q, k, v = r[..., 0, :], r[..., 1, :], r[..., 2, :]
+
+    want = flash_attention(q, k, v, causal=causal, backend="pallas",
+                           interpret=True).reshape(B, T, H * D)
+    got = flash_attention_qkv(qkv, H, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    cot = jnp.asarray(rng.randn(B, T, H * D), jnp.float32)
+
+    def loss_packed(qkv):
+        return jnp.sum(flash_attention_qkv(qkv, H, causal=causal,
+                                           interpret=True) * cot)
+
+    def loss_split(qkv):
+        r = qkv.reshape(B, T, H, 3, D)
+        o = flash_attention(r[..., 0, :], r[..., 1, :], r[..., 2, :],
+                            causal=causal, backend="pallas",
+                            interpret=True)
+        return jnp.sum(o.reshape(B, T, H * D) * cot)
+
+    gp = jax.grad(loss_packed)(qkv)
+    gs = jax.grad(loss_split)(qkv)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_qkv_rejects_untilable():
+    from horovod_tpu.ops.pallas_attention import flash_attention_qkv
+    qkv = jnp.zeros((1, 100, 2 * 3 * 128), jnp.float32)  # T % 128 != 0
+    with pytest.raises(ValueError, match="tilable|128"):
+        flash_attention_qkv(qkv, 2, interpret=True)

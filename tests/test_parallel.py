@@ -580,3 +580,37 @@ class TestChunkedLoss:
         with pytest.raises(ValueError, match="divide vocab"):
             chunked_nll(jnp.zeros((2, 4, 32)), jnp.zeros((64, 32)),
                         jnp.zeros((2, 4), jnp.int32), cfg)
+
+
+class TestPackedQKVAttention:
+    """The packed-qkv kernel branch (d_head=128, pallas backend) must
+    compute the same function as the xla-backend split path INSIDE the
+    sharded train step — including under tensor parallelism, where heads
+    shard and n_heads_local differs from n_heads."""
+
+    def _two_steps(self, backend, mesh_axes):
+        from horovod_tpu.parallel.transformer import (
+            TransformerConfig, make_parallel_train_step)
+        from horovod_tpu.parallel.mesh import create_hybrid_mesh
+        cfg = TransformerConfig(vocab=64, d_model=256, n_heads=2,
+                                n_layers=2, d_ff=128, dtype=jnp.float32,
+                                unembed_dtype=jnp.float32,
+                                attn_backend=backend)  # d_head = 128
+        n_dev = int(np.prod(list(mesh_axes.values())))
+        mesh = create_hybrid_mesh(devices=jax.devices()[:n_dev],
+                                  **mesh_axes)
+        init_state, step = make_parallel_train_step(cfg, mesh,
+                                                    optax.sgd(0.1))
+        params, opt = init_state(jax.random.PRNGKey(7))
+        rng = np.random.RandomState(3)
+        tokens = jnp.asarray(rng.randint(0, 64, (4, 256)), jnp.int32)
+        labels = jnp.roll(tokens, -1, axis=1)
+        params, opt, l1 = step(params, opt, tokens, labels)
+        _, _, l2 = step(params, opt, tokens, labels)
+        return float(l1), float(l2)
+
+    @pytest.mark.parametrize("mesh_axes", [dict(dp=2), dict(dp=2, tp=2)])
+    def test_matches_xla_backend(self, mesh_axes):
+        xla = self._two_steps("xla", mesh_axes)
+        packed = self._two_steps("pallas", mesh_axes)
+        np.testing.assert_allclose(packed, xla, rtol=1e-4, atol=1e-5)
