@@ -722,6 +722,28 @@ struct PendingTensor {
   int count = 0;
 };
 
+// Whether a hello's ring advertise-address suffix is a well-formed IPv4
+// literal ("a.b.c.d" or "a.b.c.d:port", port 1-65535). Conforming clients
+// validate HOROVOD_RING_ADVERTISE_ADDR before sending it (Client::Hello
+// below); the coordinator re-validates at hello so a NONconforming
+// client's garbage address gets a named hello rejection HERE instead of
+// being distributed in ring plans and surfacing one op later as connector
+// failures on OTHER ranks.
+static bool ValidAdvertiseAddr(const std::string& a) {
+  size_t colon = a.find(':');
+  std::string ip = a.substr(0, colon);
+  in_addr probe{};
+  if (ip.empty() || inet_pton(AF_INET, ip.c_str(), &probe) != 1)
+    return false;
+  if (colon == std::string::npos) return true;
+  const char* s = a.c_str() + colon + 1;
+  char* end = nullptr;
+  errno = 0;
+  long p = strtol(s, &end, 10);
+  return end != s && *end == '\0' && errno != ERANGE && p >= 1 &&
+         p <= 65535;
+}
+
 class Coordinator {
  public:
   Coordinator(int size, int port, int64_t fusion_threshold, double stall_secs,
@@ -830,6 +852,12 @@ class Coordinator {
         } else if (client_fds_[rank] != -1) {
           o << "duplicate rank " << rank
             << " (two processes claim the same rank; check HVD_RANK)";
+          reject = o.str();
+        } else if (!advertise.empty() && !ValidAdvertiseAddr(advertise)) {
+          o << "malformed ring advertise address \"" << advertise
+            << "\" from rank " << rank << " (expected an IPv4 literal "
+            << "\"a.b.c.d\" or \"a.b.c.d:port\" with port 1-65535; "
+            << "check HOROVOD_RING_ADVERTISE_ADDR on that host)";
           reject = o.str();
         }
       }
@@ -1627,23 +1655,13 @@ class Client {
     // generic TransportError pointing nowhere.
     if (const char* adv = getenv("HOROVOD_RING_ADVERTISE_ADDR")) {
       std::string a(adv);
-      size_t colon = a.find(':');
-      std::string ip = a.substr(0, colon);
-      bool ok_addr = true;
-      in_addr probe{};
-      if (ip.empty() || inet_pton(AF_INET, ip.c_str(), &probe) != 1)
-        ok_addr = false;
-      if (ok_addr && colon != std::string::npos) {
-        // The port must parse fully and fit uint16, or the peers'
-        // connectors would atoi a prefix and burn the full IO timeout
-        // connecting to the wrong port.
-        char* end = nullptr;
-        errno = 0;
-        long p = strtol(a.c_str() + colon + 1, &end, 10);
-        ok_addr = end != a.c_str() + colon + 1 && *end == '\0' &&
-                  errno != ERANGE && p >= 1 && p <= 65535;
-      }
-      if (!ok_addr) {
+      // Shared with the coordinator's hello-side re-validation
+      // (ValidAdvertiseAddr): both ends must agree on what is
+      // well-formed, or a value one side accepts gets rejected (or
+      // distributed) by the other. The port must parse fully and fit
+      // uint16, or the peers' connectors would atoi a prefix and burn
+      // the full IO timeout connecting to the wrong port.
+      if (!ValidAdvertiseAddr(a)) {
         fprintf(stderr,
                 "hvdcoord: ignoring malformed HOROVOD_RING_ADVERTISE_ADDR"
                 "=\"%s\" (expected an IPv4 literal \"a.b.c.d\" or "
@@ -1726,7 +1744,11 @@ class Client {
   // takes the star. `flags` is the per-call plane override (the analog of
   // the reference's per-call device_dense=/device_sparse= placement knobs,
   // horovod/tensorflow/__init__.py:43-55): 0 = auto (threshold), 1 =
-  // force star, 2 = force the peer plane regardless of size.
+  // force star, 2 = force the peer plane regardless of payload size.
+  // At world size 1 every plane is the identity (there are no peers to
+  // move bytes between), so flags==2 is trivially satisfied by the local
+  // path rather than a silent degrade — only an UNAVAILABLE peer plane at
+  // size > 1 is an error, reported by hvdcoord_submit before this runs.
   bool Submit(Request req, int flags = 0) {
     bool kind_ringable =
         (req.type == ReqType::kAllreduce ||
@@ -2452,10 +2474,12 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
   if (data && nbytes > 0)
     req.payload.assign(reinterpret_cast<const char*>(data),
                        static_cast<size_t>(nbytes));
-  if (plane == 2 && !G->client->peer_plane_available()) {
+  if (plane == 2 && G->size > 1 && !G->client->peer_plane_available()) {
     // An explicit force must not silently degrade to the star: the other
     // ranks would announce the ring variant and the world would fail with
     // a misattributed cross-rank mismatch error. Name the real cause.
+    // (At size 1 every plane is the identity — no peers, nothing to
+    // degrade — so the force is trivially satisfied, not an error.)
     snprintf(err, errlen,
              "plane=\"ring\" forced but the peer data plane is unavailable "
              "on rank %d (the ephemeral peer-listen socket failed to bind "

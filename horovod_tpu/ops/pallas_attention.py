@@ -246,6 +246,83 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dqkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, *,
+                 causal: bool, bq: int, bk: int,
+                 qi_axis: int = 1, kb_axis: int = 2,
+                 q_scale: Optional[float] = None,
+                 grad_scale: float = _LN2):
+    """Fused single-pass backward: dq, dk and dv from ONE visit of each
+    (qi, kb) tile pair.
+
+    The split dq / dkv kernels each recompute the probability tile and the
+    dO·Vᵀ matmul and each stream q/k/v/do from HBM — and the kernels are
+    VPU-softmax-bound (measured fwd 41 vs matmul 157 TF/s), so the second
+    exp2 recompute pass is pure waste. Here one grid (…, qi, kb) computes
+    s/p/dp/ds once per pair: dq accumulates per-qi in a [BQ, D] scratch
+    (written at the kb edge, as before), while dk/dv accumulate into
+    full-T [T, D] f32 VMEM scratch across the whole (qi, kb) space and
+    are flushed once per (batch, head) at the final step. Halves the
+    softmax recompute, the dp matmul and the HBM streaming of the backward
+    (7 matmuls + 2 exp2 passes per pair across two kernels -> 5 + 1).
+    Costs 2·T·D f32 of VMEM (1 MiB per 2048×128) — callers fall back to
+    the split kernels when T exceeds ``_FUSED_BWD_MAX_T``.
+    """
+    qi = pl.program_id(qi_axis)
+    kb = pl.program_id(kb_axis)
+    n_qi = pl.num_programs(qi_axis)
+    n_kb = pl.num_programs(kb_axis)
+
+    @pl.when((qi == 0) & (kb == 0))
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(kb == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _causal_run(qi, kb, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        qs = q
+        if q_scale is not None:
+            qs = (q.astype(jnp.float32) * q_scale).astype(q_ref.dtype)
+        s = _scores(qs, k, qi, kb, causal=causal, bq=bq, bk=bk)
+        p = jnp.exp2(s - lse_ref[0][:, :1])              # [BQ, BK]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dsc = ds.astype(k.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            dsc, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = pl.ds(kb * bk, bk)
+        dv_acc[rows, :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # Pᵀ·dO
+        dk_acc[rows, :] += jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # dSᵀ·Q
+
+    @pl.when(kb == n_kb - 1)
+    def _fin_q():
+        dq_ref[0] = (dq_acc[:] * grad_scale).astype(dq_ref.dtype)
+
+    @pl.when((qi == n_qi - 1) & (kb == n_kb - 1))
+    def _fin_kv():
+        dk_ref[0] = (dk_acc[:] * grad_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# Above this kv length the fused backward's full-T dk/dv accumulators
+# (2·T·D f32 + the [T, D] output blocks) stop being cheap VMEM residents
+# and the split dq/dkv kernels take over. 8192×128 = 4 MiB of scratch.
+_FUSED_BWD_MAX_T = 8192
+
+
 # Lane width of the per-row stat tensors (lse, delta) on the wire between
 # kernels. Only lane 0 carries data; 8 lanes (one f32 sublane tile) keeps
 # Mosaic layouts happy while cutting the streamed stat traffic 16x vs the
@@ -339,6 +416,27 @@ def _flash_core_bwd(causal, interpret, res, do):
     delta = jnp.broadcast_to(delta, (BH, T, _STAT_LANES))
     qkv_spec_q = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
     qkv_spec_k = pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0))
+    if T <= _FUSED_BWD_MAX_T:
+        full = pl.BlockSpec((1, T, D), lambda bh, qi, kb: (bh, 0, 0))
+        return pl.pallas_call(
+            functools.partial(_dqkv_kernel, causal=causal, bq=bq, bk=bk),
+            grid=(BH, T // bq, T // bk),
+            in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
+                      _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0)),
+                      _row_spec(bq, lambda bh, qi, kb: (bh, qi, 0))],
+            out_specs=[qkv_spec_q, full, full],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+                jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+                jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                            pltpu.VMEM((T, D), jnp.float32),
+                            pltpu.VMEM((T, D), jnp.float32)],
+            compiler_params=_grid_params(
+                ("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, bq=bq, bk=bk),
         grid=(BH, T // bq, T // bk),
@@ -476,6 +574,32 @@ def _flash_qkv_core_bwd(H, causal, sm_scale, interpret, res, do):
     do_q = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
     stat_q = pl.BlockSpec((1, bq, _STAT_LANES),
                           lambda b, h, qi, kb: (b * H + h, qi, 0))
+    if T <= _FUSED_BWD_MAX_T:
+        dq_spec = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
+        full = pl.BlockSpec((1, T, D), lambda b, h, qi, kb: (b, 0, h))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_kernel, causal=causal, bq=bq, bk=bk,
+                              qi_axis=2, kb_axis=3, q_scale=c,
+                              grad_scale=sm_scale),
+            grid=(B, H, T // bq, T // bk),
+            in_specs=[sq, sk, sv, do_q, stat_q, stat_q],
+            out_specs=[dq_spec, full, full],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                            pltpu.VMEM((T, D), jnp.float32),
+                            pltpu.VMEM((T, D), jnp.float32)],
+            compiler_params=_grid_params(
+                ("parallel", "parallel", "arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(qkv, qkv, qkv, do, lse, delta)
+        d_qkv = jnp.stack(
+            [g.reshape(B, T, H, D) for g in (dq, dk, dv)],
+            axis=3).reshape(B, T, H * 3 * D)
+        return (d_qkv,)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, bq=bq, bk=bk,
                           qi_axis=2, kb_axis=3, q_scale=c,

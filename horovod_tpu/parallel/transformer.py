@@ -246,9 +246,14 @@ def chunked_nll(x, embed, labels, cfg: TransformerConfig):
     orig_shape = x.shape[:-1]
     d = x.shape[-1]
     xf = x.reshape(-1, d)
-    lab = labels.reshape(-1)
-    n = xf.shape[0]
     vocab = embed.shape[0]
+    # Clamp labels into [0, vocab): the dense path's take_along_axis clips
+    # out-of-range indices to a real logit, while an unclamped chunked scan
+    # would treat such a label as absent from every chunk (ll stays 0, nll
+    # becomes the full lse) — toggling loss_chunk must not change the loss
+    # on any input.
+    lab = jnp.clip(labels.reshape(-1), 0, vocab - 1)
+    n = xf.shape[0]
     chunk = cfg.loss_chunk
     if vocab % chunk:
         raise ValueError(

@@ -934,6 +934,59 @@ def test_old_build_hello_gets_specific_version_message():
         assert f"rank {rank}: OK" in out
 
 
+def test_malformed_advertise_addr_rejected_at_hello():
+    """A hello carrying a garbage ring advertise-address suffix (a
+    NONconforming client — conforming ones validate it before sending,
+    ADVICE r4 #2) must be rejected AT HELLO with a named ack, instead of
+    the address being distributed in ring plans and surfacing one op later
+    as connector failures on other ranks — and the real world must still
+    form."""
+    import socket as socket_mod
+    import struct
+    import textwrap
+    port = _free_port()
+    script = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(HERE)!r})
+        import numpy as np
+        from horovod_tpu.coord.client import CoordClient
+
+        rank = int(os.environ["HVD_RANK"])
+        c = CoordClient(rank, 2, "127.0.0.1", {port})
+        out = np.asarray(c.collective(
+            "allreduce", np.ones(3, np.float32), "t.ok"))
+        assert np.allclose(out, 2.0), out
+        print(f"rank {{rank}}: OK", flush=True)
+        c.shutdown()
+    """)
+
+    def _spawn(rank):
+        env = dict(os.environ, HVD_RANK=str(rank), PYTHONPATH="",
+                   JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    procs = [_spawn(0)]
+    _wait_port_listening(port)
+    for bad in (b"evil-host.example:1234",   # hostname, not an IPv4 literal
+                b"10.0.0.1:notaport",        # unparsable port
+                b"10.0.0.1:99999"):          # port out of uint16 range
+        hello = struct.pack("<iiii", 1, 2, 5, 12345) + bad
+        s = socket_mod.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(struct.pack("<Q", len(hello)) + hello)
+        s.settimeout(10)
+        ack = s.recv(65536)
+        s.close()
+        assert b"malformed ring advertise address" in ack, (bad, ack)
+        assert b"HOROVOD_RING_ADVERTISE_ADDR" in ack, (bad, ack)
+    procs.append(_spawn(1))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank {rank}:\n{out}"
+        assert f"rank {rank}: OK" in out
+
+
 def test_malformed_ring_threshold_env_is_rejected_loudly():
     """HOROVOD_RING_THRESHOLD=4M must NOT silently parse as 4 bytes
     (ADVICE r3 #3): the malformed value is rejected with a stderr

@@ -62,6 +62,28 @@ def test_flash_grad_bf16_runs():
     assert np.isfinite(np.asarray(g, dtype=np.float32)).all()
 
 
+def test_split_backward_fallback_matches_dense(monkeypatch):
+    """The long-context split dq/dkv kernels (taken when T exceeds
+    _FUSED_BWD_MAX_T, where the fused backward's full-T VMEM accumulators
+    stop fitting) must stay grad-correct."""
+    import horovod_tpu.ops.pallas_attention as pa
+    monkeypatch.setattr(pa, "_FUSED_BWD_MAX_T", 0)
+    B, T, H, D = 1, 256, 2, 128
+    rng = np.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32) * 0.5
+               for _ in range(3))
+    cot = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    got = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, backend="pallas", interpret=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, D ** -0.5) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
 def test_fallback_on_untiled_shapes():
     B, T, H, D = 1, 24, 2, 16  # not kernel-tilable -> XLA fallback
     rng = np.random.RandomState(1)

@@ -1,0 +1,193 @@
+"""Fused Pallas conv+BN+ReLU vs the stock XLA path (interpret mode on CPU).
+
+The contract under test (``ops/pallas_conv.py``): the fused op computes the
+same math as prologue-affine+ReLU -> 1x1 conv -> stats, and its custom VJP
+— including the stats-cotangent injection that realizes training-mode
+BatchNorm's backward through mu/sigma — matches autodiff through a plain
+jnp reference. At module level, ``BottleneckBlock(fused=True)`` must match
+the stock block on the SAME params (the checkpoint-compatibility claim).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import pallas_conv
+from horovod_tpu.models import resnet
+
+
+def _reference(x2, w, ab=None, relu=True):
+    """Plain-jnp mirror of fused_linear_bn_act."""
+    u = x2
+    if ab is not None:
+        u = ab[0][None, :] * x2.astype(jnp.float32) + ab[1][None, :]
+        if relu:
+            u = jnp.maximum(u, 0.0)
+        u = u.astype(x2.dtype)
+    y = (u.astype(jnp.float32) @ w.astype(jnp.float32)).astype(x2.dtype)
+    yf = y.astype(jnp.float32)
+    return y, jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+
+
+@pytest.mark.parametrize("prologue", [False, True])
+def test_fused_forward_matches_reference(prologue):
+    rng = np.random.RandomState(0)
+    m, cin, cout = 384, 16, 24
+    x = jnp.asarray(rng.randn(m, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(cin, cout) * 0.1, jnp.float32)
+    ab = jnp.asarray(rng.randn(2, cin), jnp.float32) if prologue else None
+    y, s1, s2 = pallas_conv.fused_linear_bn_act(x, w, ab, interpret=True)
+    ry, rs1, rs2 = _reference(x, w, ab)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ry),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1[0]), np.asarray(rs1),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s2[0]), np.asarray(rs2),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("prologue", [False, True])
+def test_fused_grads_match_reference(prologue):
+    """The single-pass fused backward (dx, dW, dab + stats-cotangent
+    injection) vs autodiff through the jnp reference. The loss consumes y
+    AND a BatchNorm-like function of (s1, s2) so the ds1/ds2 paths carry
+    real cotangents."""
+    rng = np.random.RandomState(1)
+    m, cin, cout = 256, 12, 20
+    x = jnp.asarray(rng.randn(m, cin), jnp.float32)
+    w = jnp.asarray(rng.randn(cin, cout) * 0.1, jnp.float32)
+    ab = jnp.asarray(rng.randn(2, cin), jnp.float32)
+    cot = jnp.asarray(rng.randn(m, cout), jnp.float32)
+
+    def _bn_like(y, s1, s2):
+        mu = s1 / m
+        var = s2 / m - mu * mu
+        a = jax.lax.rsqrt(var + 1e-5)
+        return jnp.sum((y.astype(jnp.float32) - mu[None, :]) * a[None, :]
+                       * cot)
+
+    def loss_fused(x, w, ab):
+        args = (x, w, ab if prologue else None)
+        y, s1, s2 = pallas_conv.fused_linear_bn_act(*args, interpret=True)
+        return _bn_like(y, s1[0], s2[0])
+
+    def loss_ref(x, w, ab):
+        y, s1, s2 = _reference(x, w, ab if prologue else None)
+        return _bn_like(y, s1, s2)
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, ab)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, ab)
+    for g, r, name in zip(got, want, ("dx", "dw", "dab")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def _named_leaves(tree):
+    return sorted((str(k), v)
+                  for k, v in jax.tree_util.tree_leaves_with_path(tree))
+
+
+def _block_pair(strides, cin, filters=8):
+    conv = functools.partial(resnet.nn.Conv, use_bias=False,
+                             dtype=jnp.float32)
+    norm = functools.partial(resnet.nn.BatchNorm, use_running_average=False,
+                             momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+    stock = resnet.BottleneckBlock(filters, strides=strides, conv=conv,
+                                   norm=norm)
+    fused = resnet.BottleneckBlock(filters, strides=strides, conv=conv,
+                                   norm=norm, fused=True)
+    return stock, fused
+
+
+@pytest.mark.parametrize("strides,cin", [((1, 1), 16), ((2, 2), 32)])
+def test_fused_block_matches_stock_on_same_params(strides, cin):
+    """Same variable tree, same outputs, same grads, same running-stat
+    updates — conv_backend is a pure performance knob."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 16, 16, cin), jnp.float32)  # M=512
+    stock, fused = _block_pair(strides, cin)
+    variables = stock.init(jax.random.PRNGKey(0), x)
+    fvars = fused.init(jax.random.PRNGKey(0), x)
+    assert (jax.tree_util.tree_structure(variables)
+            == jax.tree_util.tree_structure(fvars))
+
+    out_s, upd_s = stock.apply(variables, x, mutable=["batch_stats"])
+    out_f, upd_f = fused.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_s),
+                               rtol=2e-4, atol=2e-4)
+    for (ks, vs), (kf, vf) in zip(_named_leaves(upd_s),
+                                  _named_leaves(upd_f)):
+        np.testing.assert_allclose(np.asarray(vf), np.asarray(vs),
+                                   rtol=2e-4, atol=2e-4, err_msg=ks)
+
+    cot = jnp.asarray(rng.randn(*out_s.shape), jnp.float32)
+
+    def loss(block, params):
+        out, _ = block.apply({"params": params,
+                              "batch_stats": variables["batch_stats"]},
+                             x, mutable=["batch_stats"])
+        return jnp.sum(out * cot)
+
+    gs = jax.grad(lambda p: loss(stock, p))(variables["params"])
+    gf = jax.grad(lambda p: loss(fused, p))(variables["params"])
+    for (ks, vs), (kf, vf) in zip(_named_leaves(gs), _named_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(vf), np.asarray(vs),
+                                   rtol=5e-4, atol=5e-4, err_msg=ks)
+
+
+def test_fused_block_eval_uses_stock_branch():
+    """Eval mode (use_running_average) must route to the stock XLA branch
+    and agree with it exactly."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 16, 16, 16), jnp.float32)
+    conv = functools.partial(resnet.nn.Conv, use_bias=False,
+                             dtype=jnp.float32)
+    norm = functools.partial(resnet.nn.BatchNorm, use_running_average=True,
+                             momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+    stock = resnet.BottleneckBlock(8, conv=conv, norm=norm)
+    fused = resnet.BottleneckBlock(8, conv=conv, norm=norm, fused=True)
+    variables = stock.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(np.asarray(fused.apply(variables, x)),
+                                  np.asarray(stock.apply(variables, x)))
+
+
+def test_fused_resnet50_variables_match_stock():
+    """Whole-model: conv_backend='fused' yields the identical variable
+    tree (checkpoint interop) and a close forward."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    stock = resnet.resnet50(num_classes=10, dtype=jnp.float32)
+    fused = resnet.resnet50(num_classes=10, dtype=jnp.float32,
+                            conv_backend="fused")
+    variables = stock.init(jax.random.PRNGKey(0), x)
+    fvars = fused.init(jax.random.PRNGKey(0), x)
+    assert (jax.tree_util.tree_structure(variables)
+            == jax.tree_util.tree_structure(fvars))
+    out_s, _ = stock.apply(variables, x, mutable=["batch_stats"])
+    out_f, _ = fused.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_s),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_bf16_fused_block_runs_and_is_finite():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(2, 16, 16, 16), jnp.bfloat16)
+    conv = functools.partial(resnet.nn.Conv, use_bias=False,
+                             dtype=jnp.bfloat16)
+    norm = functools.partial(resnet.nn.BatchNorm, use_running_average=False,
+                             momentum=0.9, epsilon=1e-5, dtype=jnp.bfloat16)
+    fused = resnet.BottleneckBlock(8, conv=conv, norm=norm, fused=True)
+    variables = fused.init(jax.random.PRNGKey(0), x)
+
+    def loss(p):
+        out, _ = fused.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x, mutable=["batch_stats"])
+        return jnp.sum(out.astype(jnp.float32))
+
+    g = jax.grad(loss)(variables["params"])
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.isfinite(np.asarray(leaf, np.float32)).all()

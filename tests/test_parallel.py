@@ -581,6 +581,28 @@ class TestChunkedLoss:
             chunked_nll(jnp.zeros((2, 4, 32)), jnp.zeros((64, 32)),
                         jnp.zeros((2, 4), jnp.int32), cfg)
 
+    def test_out_of_range_labels_match_dense(self):
+        """ADVICE r4 #1: a padding/ignore-index label (e.g. -1 or vocab)
+        must produce the SAME per-token nll as the dense path (which clips
+        via take_along_axis) — toggling loss_chunk must not change the
+        loss on any input."""
+        from horovod_tpu.parallel.transformer import (
+            TransformerConfig, chunked_nll)
+        cfg = TransformerConfig(**self.CFG, loss_chunk=16)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 4, 32), jnp.float32)
+        embed = jnp.asarray(rng.randn(64, 32) * 0.1, jnp.float32)
+        labels = jnp.asarray([[-1, 0, 63, 64], [7, -5, 100, 1]],
+                             jnp.int32)
+
+        logits = x @ embed.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        dense = -jnp.take_along_axis(
+            logp, jnp.clip(labels, 0, 63)[..., None], axis=-1)[..., 0]
+        got = chunked_nll(x, embed, labels, cfg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                                   rtol=1e-5, atol=1e-6)
+
 
 class TestPackedQKVAttention:
     """The packed-qkv kernel branch (d_head=128, pallas backend) must
