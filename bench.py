@@ -421,6 +421,11 @@ def main() -> None:
         return
     cfg = _bench_config(args.model or "resnet50")
     if args.conv_backend:
+        if cfg["model"] not in ("resnet50", "resnet101"):
+            raise SystemExit(
+                "--conv-backend applies to the resnet models only (the "
+                "fused kernel targets bottleneck 1x1 convs); a silent "
+                "ignore would mislabel a stock run as a fused measurement")
         cfg["conv_backend"] = args.conv_backend
 
     if args.scaling:

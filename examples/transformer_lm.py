@@ -43,6 +43,16 @@ def main():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save the SHARDED (params, opt_state) trees here "
+                        "every --checkpoint-every steps "
+                        "(parallel/checkpoint.py: each array written with "
+                        "its NamedSharding layout)")
+    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest checkpoint in "
+                        "--checkpoint-dir (restores onto the mesh layout "
+                        "and continues at the saved step)")
     args = p.parse_args()
 
     n = len(jax.devices())
@@ -80,12 +90,32 @@ def main():
                                      (args.batch, args.seq)), jnp.int32)
     labels = jnp.roll(tokens, 1, axis=1)
 
+    start = 0
+    if args.resume:
+        if args.pp > 1:
+            raise SystemExit("--resume covers the non-pp family for now")
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        from horovod_tpu.parallel import restore_sharded
+        params, opt_state, start = restore_sharded(
+            args.checkpoint_dir, params, opt_state)
+        print(f"resumed from step {start}")
+        if start >= args.steps:
+            print(f"nothing to do: checkpoint step {start} >= "
+                  f"--steps {args.steps}")
+            return
+
     losses = []
-    for i in range(args.steps):
+    for i in range(start, args.steps):
         params, opt_state, loss = step(params, opt_state, tokens, labels)
         losses.append(float(loss))
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i:4d} loss {losses[-1]:.4f}", flush=True)
+        if (args.checkpoint_dir and args.pp == 1
+                and (i + 1) % args.checkpoint_every == 0):
+            from horovod_tpu.parallel import save_sharded
+            save_sharded(args.checkpoint_dir, i + 1, params, opt_state,
+                         max_to_keep=3)
     assert losses[-1] < losses[0], (losses[0], losses[-1])
     print(f"OK: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
 

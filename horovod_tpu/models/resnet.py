@@ -191,14 +191,26 @@ class BottleneckBlock(nn.Module):
                     axis_name=norm_kw.get("axis_name"))
 
     def _fused_call(self, x, st):
+        import os
+        # Experimental sub-knob (measurement tool, not API): which of the
+        # block's 1x1 convs route through the fused kernel. Used to
+        # attribute the pallas-boundary tax per conv site
+        # (docs/benchmarks.md r5 fused-conv experiment).
+        parts = os.environ.get("HVD_FUSED_PARTS",
+                               "reduce,expand,shortcut").split(",")
         dtype = st["dtype"]
         bn = functools.partial(
             _FoldedBN, use_running_average=False, momentum=st["momentum"],
             epsilon=st["epsilon"], axis_name=st["axis_name"])
         f = self.filters
         # 1x1 reduce: raw input in, stats epilogue out.
-        y, s1, s2, cnt = _FusedConv1x1(f, dtype=dtype, name="Conv_0")(x)
-        a1, b1 = bn(name="BatchNorm_0")(s1, s2, cnt)
+        if "reduce" in parts:
+            y, s1, s2, cnt = _FusedConv1x1(f, dtype=dtype,
+                                           name="Conv_0")(x)
+            a1, b1 = bn(name="BatchNorm_0")(s1, s2, cnt)
+        else:
+            y = self.conv(f, (1, 1), name="Conv_0")(x)
+            a1, b1 = bn(name="BatchNorm_0")(x=y)
         z = nn.relu(a1 * y.astype(jnp.float32) + b1).astype(dtype)
         # 3x3 (carries the stride): XLA's conv — compute-bound at these
         # shapes, not worth a hand kernel; its BN stats are one XLA
@@ -208,15 +220,27 @@ class BottleneckBlock(nn.Module):
         a2, b2 = bn(name="BatchNorm_1")(x=y)
         # 1x1 expand: BN+ReLU prologue (never materializes relu(bn(y))),
         # stats epilogue (never re-reads the 4f-channel output).
-        y, s1, s2, cnt = _FusedConv1x1(4 * f, dtype=dtype, name="Conv_2")(
-            y, jnp.stack([a2, b2]))
-        a3, b3 = bn(name="BatchNorm_2",
-                    scale_init=nn.initializers.zeros)(s1, s2, cnt)
+        if "expand" in parts:
+            y, s1, s2, cnt = _FusedConv1x1(4 * f, dtype=dtype,
+                                           name="Conv_2")(
+                y, jnp.stack([a2, b2]))
+            a3, b3 = bn(name="BatchNorm_2",
+                        scale_init=nn.initializers.zeros)(s1, s2, cnt)
+        else:
+            z2 = nn.relu(a2 * y.astype(jnp.float32) + b2).astype(dtype)
+            y = self.conv(4 * f, (1, 1), name="Conv_2")(z2)
+            a3, b3 = bn(name="BatchNorm_2",
+                        scale_init=nn.initializers.zeros)(x=y)
         if x.shape[-1] != 4 * f or self.strides != (1, 1):
-            xs = x[:, ::self.strides[0], ::self.strides[1], :]
-            ys, s1s, s2s, cnts = _FusedConv1x1(
-                4 * f, dtype=dtype, name="shortcut")(xs)
-            a4, b4 = bn(name="shortcut_bn")(s1s, s2s, cnts)
+            if "shortcut" in parts:
+                xs = x[:, ::self.strides[0], ::self.strides[1], :]
+                ys, s1s, s2s, cnts = _FusedConv1x1(
+                    4 * f, dtype=dtype, name="shortcut")(xs)
+                a4, b4 = bn(name="shortcut_bn")(s1s, s2s, cnts)
+            else:
+                ys = self.conv(4 * f, (1, 1), self.strides,
+                               name="shortcut")(x)
+                a4, b4 = bn(name="shortcut_bn")(x=ys)
             residual = a4 * ys.astype(jnp.float32) + b4
         else:
             residual = x.astype(jnp.float32)
@@ -310,10 +334,13 @@ class ResNet(nn.Module):
     # "xla" = stock convs; "fused" = route training-mode 1x1 convs in
     # bottleneck blocks through the fused Pallas conv+BN+ReLU kernel
     # (checkpoint-compatible — see BottleneckBlock). ``fused_stages``
-    # selects which stages fuse (all by default; the HBM-bound win
-    # concentrates in the large-spatial-map stages 0-1).
+    # selects which stages fuse: the default is the large-spatial-map
+    # stages 0-1 where the 1x1 convs are HBM-bound (measured r5 profile:
+    # fusing the deep compute-bound stages too REGRESSES ~2x — XLA's
+    # MXU-rich conv kernels win there and every pallas boundary costs
+    # layout copies; see docs/benchmarks.md).
     conv_backend: str = "xla"
-    fused_stages: Sequence[int] = (0, 1, 2, 3)
+    fused_stages: Sequence[int] = (0, 1)
 
     @nn.compact
     def __call__(self, x, train: bool = True):

@@ -6,6 +6,7 @@ parallelism, GPipe pipeline parallelism, and GShard expert parallelism —
 all as shard_map-native building blocks over `create_hybrid_mesh`.
 """
 
+from .checkpoint import restore_sharded, save_sharded  # noqa: F401
 from .mesh import AXES, axis_size, create_hybrid_mesh  # noqa: F401
 from .moe import moe_ffn  # noqa: F401
 from .pipeline import gpipe, one_f_one_b  # noqa: F401

@@ -32,7 +32,13 @@ setup(
     package_data={"horovod_tpu.coord": ["libhvdcoord.so", "coordinator.cc",
                                         "Makefile"]},
     python_requires=">=3.10",
-    install_requires=["jax", "flax", "optax", "orbax-checkpoint", "numpy"],
+    # jax floor: 0.9 is the version every CI leg verifies (this image
+    # ships exactly one jax, so older floors would be untested claims).
+    # The only cross-version API the package touches is
+    # all_gather_invariant, shimmed for three jax generations in
+    # utils/compat.py (README "Version matrix" states the coverage).
+    install_requires=["jax>=0.9", "flax", "optax", "orbax-checkpoint",
+                      "numpy"],
     # "digits" real-dataset loader (data.load_dataset) needs sklearn.
     extras_require={"datasets": ["scikit-learn"]},
     scripts=["bin/tpurun"],

@@ -1,0 +1,90 @@
+"""Sharding-aware checkpoint/resume for the hybrid-mesh transformer.
+
+The contract (VERDICT r4 weak #6): a dp x tp run checkpoints its
+tp-sharded global params + optimizer state, a fresh process restores them
+onto the same mesh layout, and the resumed run BIT-matches a continuous
+one — the §5.4 resume protocol extended to sharded state.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+CFG = dict(vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+
+def _build(mesh_kw):
+    from horovod_tpu.parallel import (TransformerConfig,
+                                      create_hybrid_mesh,
+                                      make_parallel_train_step)
+    cfg = TransformerConfig(**CFG)
+    import math
+    n = math.prod(mesh_kw.values())
+    mesh = create_hybrid_mesh(**mesh_kw, devices=jax.devices()[:n])
+    init_state, step = make_parallel_train_step(cfg, mesh, optax.adam(1e-2))
+    return cfg, mesh, init_state, step
+
+
+def _data(cfg, batch=4, seq=16):
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, CFG["vocab"], (batch, seq)),
+                         jnp.int32)
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(dp=2, tp=2), dict(dp=2, tp=4)])
+def test_sharded_resume_bit_matches_continuous_run(tmp_path, mesh_kw):
+    from horovod_tpu.parallel import restore_sharded, save_sharded
+    cfg, mesh, init_state, step = _build(mesh_kw)
+    tokens, labels = _data(cfg)
+
+    # Continuous run: 4 steps.
+    params, opt_state = init_state(jax.random.PRNGKey(7))
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    want = jax.tree_util.tree_map(np.asarray, params)
+
+    # Checkpointed run: 2 steps, save, RESTORE INTO A FRESH STATE, 2 more.
+    params, opt_state = init_state(jax.random.PRNGKey(7))
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    save_sharded(str(tmp_path), 2, params, opt_state)
+
+    p2, o2 = init_state(jax.random.PRNGKey(99))  # template w/ WRONG values
+    p2, o2, got_step = restore_sharded(str(tmp_path), p2, o2)
+    assert got_step == 2
+    # Restored arrays keep the template's mesh layout.
+    for leaf, ref in zip(jax.tree_util.tree_leaves(p2),
+                         jax.tree_util.tree_leaves(params)):
+        assert leaf.sharding.is_equivalent_to(ref.sharding, leaf.ndim), \
+            (leaf.sharding, ref.sharding)
+    for _ in range(2):
+        p2, o2, loss = step(p2, o2, tokens, labels)
+
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(
+                jax.tree_util.tree_map(np.asarray, p2)),
+            jax.tree_util.tree_leaves_with_path(want)):
+        np.testing.assert_array_equal(a, b, err_msg=str(ka))
+
+
+def test_retention_keeps_newest(tmp_path):
+    from horovod_tpu.parallel import restore_sharded, save_sharded
+    cfg, mesh, init_state, step = _build(dict(dp=2, tp=2))
+    params, opt_state = init_state(jax.random.PRNGKey(0))
+    for s in (1, 2, 3):
+        save_sharded(str(tmp_path), s, params, opt_state, max_to_keep=2)
+    names = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("ckpt_"))
+    assert names == ["ckpt_2", "ckpt_3"], names
+    p2, o2, got = restore_sharded(str(tmp_path), params, opt_state)
+    assert got == 3
