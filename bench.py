@@ -290,7 +290,13 @@ def lm_train_gflop_per_token(c) -> float:
 def _lm_config():
     smoke = bool(int(os.environ.get("HVD_BENCH_SMOKE", "0")))
     on_tpu = jax.default_backend() == "tpu"
-    return dict(_LM_TPU if on_tpu and not smoke else _LM_SMOKE)
+    cfg = dict(_LM_TPU if on_tpu and not smoke else _LM_SMOKE)
+    # Experiment knob (docs/benchmarks.md LM experiments table): online
+    # chunked cross-entropy instead of the dense [B,T,vocab] softmax.
+    chunk = int(os.environ.get("HVD_LM_LOSS_CHUNK", "0"))
+    if chunk:
+        cfg["loss_chunk"] = chunk
+    return cfg
 
 
 def measure_lm(cfg=None) -> float:
@@ -321,7 +327,8 @@ def measure_lm(cfg=None) -> float:
         vocab=cfg["vocab"], d_model=cfg["d_model"], n_heads=cfg["n_heads"],
         n_layers=cfg["n_layers"], d_ff=cfg["d_ff"], dtype=jnp.bfloat16,
         attn_backend="pallas" if on_tpu else "xla",
-        unembed_dtype=jnp.bfloat16, remat=bool(cfg.get("remat", False)))
+        unembed_dtype=jnp.bfloat16, remat=bool(cfg.get("remat", False)),
+        loss_chunk=int(cfg.get("loss_chunk", 0)))
     opt = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     init_state, step = make_parallel_train_step(tcfg, mesh, opt)
     params, opt_state = init_state(jax.random.PRNGKey(0))
@@ -421,11 +428,16 @@ def main() -> None:
         return
     cfg = _bench_config(args.model or "resnet50")
     if args.conv_backend:
-        if cfg["model"] not in ("resnet50", "resnet101"):
+        if (args.model or "resnet50") not in ("resnet50", "resnet101"):
             raise SystemExit(
                 "--conv-backend applies to the resnet models only (the "
                 "fused kernel targets bottleneck 1x1 convs); a silent "
                 "ignore would mislabel a stock run as a fused measurement")
+        if cfg["model"] not in ("resnet50", "resnet101"):
+            raise SystemExit(
+                "--conv-backend has no effect in smoke/CPU mode (the "
+                "fallback config swaps the model to cifar20); run on TPU "
+                "without HVD_BENCH_SMOKE for a fused measurement")
         cfg["conv_backend"] = args.conv_backend
 
     if args.scaling:

@@ -160,6 +160,14 @@ def main():
         return
 
     cfg = bench._bench_config(args.model)
+    if args.conv_backend != "xla":
+        # Same mislabel guard as bench.py: a run that silently profiles
+        # stock convs must not be recorded as a fused measurement.
+        if args.model not in ("resnet50", "resnet101") \
+                or cfg["model"] not in ("resnet50", "resnet101"):
+            raise SystemExit(
+                "--conv-backend fused applies to resnet50/resnet101 on "
+                "real TPU only")
     cfg["conv_backend"] = args.conv_backend
     if args.steps:
         cfg["steps_per_call"] = args.steps

@@ -58,6 +58,13 @@ def main():
     n = len(jax.devices())
     if args.pp > 1 and args.sp > 1:
         raise SystemExit("--pp composes with dp/tp, not sp")
+    if args.checkpoint_dir and args.pp > 1:
+        # Reject loudly rather than complete a long run with zero
+        # checkpoints written: sharded save/restore covers the non-pp
+        # family for now (parallel/checkpoint.py).
+        raise SystemExit("--checkpoint-dir covers the non-pp family for "
+                         "now (the 1F1B state is not yet wired through "
+                         "save_sharded)")
     dp = args.dp or max(n // (args.sp * args.tp * args.pp), 1)
     if dp * args.sp * args.tp * args.pp > n:
         raise SystemExit(

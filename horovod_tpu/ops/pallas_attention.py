@@ -317,6 +317,71 @@ def _dqkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _dqkv_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dqkv_ref, dq_acc, dk_acc, dv_acc, *,
+                        causal: bool, bq: int, bk: int, d: int,
+                        q_scale: float, grad_scale: float):
+    """Packed-path fused backward writing the gradient DIRECTLY in the
+    projection's packed column layout.
+
+    Grid (B, H, qi, kb); the single output block is head h's full packed
+    column stripe ``[1, T, 3D]`` of d_qkv (columns q|k|v), grid-constant
+    over (qi, kb) so it lives in VMEM for the whole (batch, head) visit:
+    dq rows land at each qi edge, dk/dv flush from the full-T accumulators
+    at the end. This removes the stack+reshape interleave the previous
+    backward needed (measured ~0.52 ms/layer of concatenate fusions plus
+    the copies around three [B,T,H*D] intermediates — the gradient now
+    exists in exactly one materialization).
+    """
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+    n_qi = pl.num_programs(2)
+    n_kb = pl.num_programs(3)
+
+    @pl.when((qi == 0) & (kb == 0))
+    def _init_kv():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(kb == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _causal_run(qi, kb, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        qs = (q.astype(jnp.float32) * q_scale).astype(q_ref.dtype)
+        s = _scores(qs, k, qi, kb, causal=causal, bq=bq, bk=bk)
+        p = jnp.exp2(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dsc = ds.astype(k.dtype)
+        dq_acc[:] += jax.lax.dot_general(
+            dsc, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = pl.ds(kb * bk, bk)
+        dv_acc[rows, :] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[rows, :] += jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _fin_q():
+        dqkv_ref[0, pl.ds(qi * bq, bq), 0:d] = \
+            (dq_acc[:] * grad_scale).astype(dqkv_ref.dtype)
+
+    @pl.when((qi == n_qi - 1) & (kb == n_kb - 1))
+    def _fin_kv():
+        dqkv_ref[0, :, d:2 * d] = \
+            (dk_acc[:] * grad_scale).astype(dqkv_ref.dtype)
+        dqkv_ref[0, :, 2 * d:3 * d] = dv_acc[:].astype(dqkv_ref.dtype)
+
+
 # Above this kv length the fused backward's full-T dk/dv accumulators
 # (2·T·D f32 + the [T, D] output blocks) stop being cheap VMEM residents
 # and the split dq/dkv kernels take over. 8192×128 = 4 MiB of scratch.
@@ -575,20 +640,14 @@ def _flash_qkv_core_bwd(H, causal, sm_scale, interpret, res, do):
     stat_q = pl.BlockSpec((1, bq, _STAT_LANES),
                           lambda b, h, qi, kb: (b * H + h, qi, 0))
     if T <= _FUSED_BWD_MAX_T:
-        dq_spec = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
-        full = pl.BlockSpec((1, T, D), lambda b, h, qi, kb: (b, 0, h))
-        dq, dk, dv = pl.pallas_call(
-            functools.partial(_dqkv_kernel, causal=causal, bq=bq, bk=bk,
-                              qi_axis=2, kb_axis=3, q_scale=c,
-                              grad_scale=sm_scale),
+        packed = pl.BlockSpec((1, T, 3 * D), lambda b, h, qi, kb: (b, 0, h))
+        d_qkv = pl.pallas_call(
+            functools.partial(_dqkv_packed_kernel, causal=causal, bq=bq,
+                              bk=bk, d=D, q_scale=c, grad_scale=sm_scale),
             grid=(B, H, T // bq, T // bk),
             in_specs=[sq, sk, sv, do_q, stat_q, stat_q],
-            out_specs=[dq_spec, full, full],
-            out_shape=[
-                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
-                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
-                jax.ShapeDtypeStruct((B, T, H * D), qkv.dtype),
-            ],
+            out_specs=packed,
+            out_shape=jax.ShapeDtypeStruct((B, T, H * 3 * D), qkv.dtype),
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
                             pltpu.VMEM((T, D), jnp.float32),
                             pltpu.VMEM((T, D), jnp.float32)],
@@ -596,9 +655,6 @@ def _flash_qkv_core_bwd(H, causal, sm_scale, interpret, res, do):
                 ("parallel", "parallel", "arbitrary", "arbitrary")),
             interpret=interpret,
         )(qkv, qkv, qkv, do, lse, delta)
-        d_qkv = jnp.stack(
-            [g.reshape(B, T, H, D) for g in (dq, dk, dv)],
-            axis=3).reshape(B, T, H * 3 * D)
         return (d_qkv,)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, bq=bq, bk=bk,

@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from .. import runtime
-from ..trainer import _step_of, latest_checkpoint_step
+from ..trainer import apply_retention, latest_checkpoint_step
 
 
 def _ckpt_path(directory: str, step: int) -> str:
@@ -47,24 +47,9 @@ def save_sharded(directory: str, step: int, params: Any,
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, {"params": params, "opt_state": opt_state},
                force=True)
-    root = (not runtime.is_initialized()
-            or runtime.world().controller_rank == 0)
-    if root and max_to_keep is not None and max_to_keep > 0:
-        import shutil
-        base = os.path.abspath(directory)
-        entries = []
-        for n in os.listdir(base):
-            if _step_of(n) is None:
-                continue
-            full = os.path.join(base, n)
-            try:
-                entries.append((os.path.getmtime(full), full))
-            except OSError:
-                continue
-        entries.sort()
-        for _, old in entries[:-max_to_keep]:
-            if old != path:
-                shutil.rmtree(old, ignore_errors=True)
+    if (not runtime.is_initialized()
+            or runtime.world().controller_rank == 0):
+        apply_retention(directory, path, max_to_keep)
     return path
 
 

@@ -154,26 +154,38 @@ def save_checkpoint(directory: str, state: TrainState,
     path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, jax.tree_util.tree_map(np.asarray, state), force=True)
-    if max_to_keep is not None and max_to_keep > 0:
-        import shutil
-        # Retention by WRITE recency, not step number: a run resumed from a
-        # rolled-back step must never have its just-written checkpoint
-        # deleted in favor of stale higher-step leftovers.
-        base = os.path.abspath(directory)
-        entries = []
-        for n in os.listdir(base):
-            if _step_of(n) is None:
-                continue
-            full = os.path.join(base, n)
-            try:
-                entries.append((os.path.getmtime(full), full))
-            except OSError:
-                continue
-        entries.sort()
-        for _, old in entries[:-max_to_keep]:
-            if old != path:
-                shutil.rmtree(old, ignore_errors=True)
+    apply_retention(directory, path, max_to_keep)
     return path
+
+
+def apply_retention(directory: str, just_written: str,
+                    max_to_keep: Optional[int]) -> None:
+    """Delete the oldest checkpoints beyond the newest ``max_to_keep``.
+
+    Retention by WRITE recency, not step number: a run resumed from a
+    rolled-back step must never have its just-written checkpoint deleted
+    in favor of stale higher-step leftovers. Shared by the replicated-DP
+    writer above and the sharded writer
+    (:mod:`horovod_tpu.parallel.checkpoint`) — one policy, one bug
+    surface.
+    """
+    if max_to_keep is None or max_to_keep <= 0:
+        return
+    import shutil
+    base = os.path.abspath(directory)
+    entries = []
+    for n in os.listdir(base):
+        if _step_of(n) is None:
+            continue
+        full = os.path.join(base, n)
+        try:
+            entries.append((os.path.getmtime(full), full))
+        except OSError:
+            continue
+    entries.sort()
+    for _, old in entries[:-max_to_keep]:
+        if old != just_written:
+            shutil.rmtree(old, ignore_errors=True)
 
 
 def _step_of(name: str) -> Optional[int]:
