@@ -140,9 +140,13 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         logits = jnp.matmul(h.astype(cfg.unembed_dtype),
                             head["embed"].T.astype(cfg.unembed_dtype),
                             preferred_element_type=jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return jnp.mean(-jnp.take_along_axis(logp, labels[..., None],
-                                             axis=-1))
+        # lse - picked, not -take(log_softmax): avoids materializing the
+        # full [*, vocab] f32 logp tensor (see parallel/transformer.py's
+        # dense loss — same math, identical gradients).
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[..., None],
+                                     axis=-1)[..., 0]
+        return jnp.mean(lse - picked)
 
     def _step(params, opt_state, tokens, labels):
         B, T = tokens.shape
