@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas_attention import flash_attention
 from .pipeline import one_f_one_b
-from .transformer import TransformerConfig, _rms_norm
+from .transformer import TransformerConfig, _rms_norm, dense_nll
 
 
 def _axes(mesh: Mesh):
@@ -140,13 +140,7 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
         logits = jnp.matmul(h.astype(cfg.unembed_dtype),
                             head["embed"].T.astype(cfg.unembed_dtype),
                             preferred_element_type=jnp.float32)
-        # lse - picked, not -take(log_softmax): avoids materializing the
-        # full [*, vocab] f32 logp tensor (see parallel/transformer.py's
-        # dense loss — same math, identical gradients).
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        picked = jnp.take_along_axis(logits, labels[..., None],
-                                     axis=-1)[..., 0]
-        return jnp.mean(lse - picked)
+        return jnp.mean(dense_nll(logits, labels))
 
     def _step(params, opt_state, tokens, labels):
         B, T = tokens.shape

@@ -233,6 +233,21 @@ def forward(params, tokens, cfg: TransformerConfig, mesh: Mesh):
     return logits, aux_total
 
 
+def dense_nll(logits, labels):
+    """Per-token -log p(label): lse - picked_logit, NOT
+    -take(log_softmax) — the log_softmax form materializes a full
+    [*, vocab] f32 logp tensor (2.1 GB at the bench config, profiled at
+    ~6.5 ms/step of pure HBM) only to gather one element per row.
+    logsumexp reduces in one pass and the gather reads the raw logits;
+    gradients are identical (softmax - onehot) either way. Shared by the
+    dp/sp/tp/ep family here and the pipeline family's head loss
+    (``pp_transformer.py``)."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]
+    return lse - picked
+
+
 def chunked_nll(x, embed, labels, cfg: TransformerConfig):
     """Per-token −log p(label) over a tied unembedding, computed in vocab
     chunks with an online log-sum-exp so the [N, vocab] f32 logits never
@@ -324,16 +339,7 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
             nll = chunked_nll(x, params["embed"], labels, cfg)
         else:
             logits, aux = forward(params, tokens, cfg, mesh)
-            # nll = lse - picked, NOT -take(log_softmax): the log_softmax
-            # form materializes a full [B,T,vocab] f32 logp tensor (2.1 GB
-            # at the bench config — profiled at ~6.5 ms/step of pure HBM)
-            # only to gather one element per row. logsumexp reduces in
-            # one pass and the gather reads the raw logits; gradients are
-            # identical (softmax - onehot) either way.
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            picked = jnp.take_along_axis(logits, labels[..., None],
-                                         axis=-1)[..., 0]
-            nll = lse - picked
+            nll = dense_nll(logits, labels)
         loss = jnp.mean(nll) + aux_weight * aux
         return loss
 

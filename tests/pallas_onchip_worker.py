@@ -60,4 +60,44 @@ for causal in (False, True):
         assert rel <= 0.02, (causal, name, rel)
         print(f"causal={causal} d{name} max-rel-err {rel:.4f}", flush=True)
 
+# Packed-qkv path (the bench path: fused projection output straight into
+# the kernels; r5 backward = one fused dq/dk/dv kernel writing the packed
+# gradient directly). Same tolerances as the split path above.
+from horovod_tpu.ops.pallas_attention import flash_attention_qkv
+
+qkv = jnp.stack((q, k, v), axis=3)                  # [B, T, H, 3, D]
+qkv_packed = qkv.reshape(B, T, H * 3 * D)
+cot_p = cot.reshape(B, T, H * D)
+
+expected = _xla_attention(qr, kr, vr, True, D ** -0.5)  # causal
+out = flash_attention_qkv(qkv_packed, H, causal=True)
+err = float(jnp.max(jnp.abs(
+    out.reshape(B, T, H, D).astype(jnp.float32) - expected)))
+scale = float(jnp.max(jnp.abs(expected)))
+assert err <= 0.03 * max(scale, 1.0), ("packed", err, scale)
+
+
+def loss_packed(qkv_packed):
+    o = flash_attention_qkv(qkv_packed, H, causal=True)
+    return jnp.sum(o.astype(jnp.float32) * cot_p.astype(jnp.float32))
+
+
+def loss_dense_packed(q, k, v):
+    return jnp.sum(_xla_attention(q, k, v, True, D ** -0.5)
+                   * cot.astype(jnp.float32))
+
+
+g_packed = jax.grad(loss_packed)(qkv_packed)
+dq_w, dk_w, dv_w = jax.grad(loss_dense_packed,
+                            argnums=(0, 1, 2))(qr, kr, vr)
+want_packed = np.stack(
+    [np.asarray(g, np.float32) for g in (dq_w, dk_w, dv_w)],
+    axis=3).reshape(B, T, H * 3 * D)
+g32 = np.asarray(g_packed, np.float32)
+denom = max(float(np.max(np.abs(want_packed))), 1.0)
+rel = float(np.max(np.abs(g32 - want_packed))) / denom
+assert rel <= 0.02, ("packed d_qkv", rel)
+print(f"packed-qkv fwd err {err:.4f}, d_qkv max-rel-err {rel:.4f}",
+      flush=True)
+
 print("PALLAS_ONCHIP_OK")
