@@ -62,6 +62,40 @@ fi
 echo "== tpurun launcher smoke (2 ranks, env-world) =="
 python -m horovod_tpu.launcher -np 2 --cpu python tests/launcher_worker.py
 
+echo "== fault-injection smoke: kill rank 2 at step 3, recover via --restarts 1 =="
+# The anti-hang drill (docs/fault_tolerance.md): rank 2 is SIGKILLed mid
+# -training; the coordinator must ABORT the world (WorkerFailureError, no
+# hang), tpurun must relaunch it once, and run_with_recovery must resume
+# from the last committed step and finish. The hard `timeout` is the
+# assertion — a regression that reintroduces the reference's dead-rank
+# hang fails CI here instead of wedging it.
+FT_DIR=$(mktemp -d)
+HVD_FAULT_SPEC=rank=2:kill@step=3 HVD_ELASTIC_DIR="$FT_DIR" \
+HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=6 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 4 --cpu --restarts 1 \
+  python tests/elastic_worker.py
+# And without --restarts the same drill must FAIL FAST (nonzero AND not
+# a timeout kill): exit 124/137 would mean the job HUNG until `timeout`
+# shot it — the exact regression this leg exists to catch.
+FT_DIR2=$(mktemp -d)
+set +e
+HVD_FAULT_SPEC=rank=2:kill@step=3 HVD_ELASTIC_DIR="$FT_DIR2" \
+HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=6 \
+  timeout -k 10 180 \
+  python -m horovod_tpu.launcher -np 4 --cpu \
+  python tests/elastic_worker.py
+ft_rc=$?
+set -e
+if [ "$ft_rc" -eq 0 ]; then
+  echo "FAIL: killed-rank world exited 0 without restarts" >&2
+  exit 1
+elif [ "$ft_rc" -eq 124 ] || [ "$ft_rc" -eq 137 ]; then
+  echo "FAIL: killed-rank world HUNG until timeout killed it (rc=$ft_rc)" >&2
+  exit 1
+fi
+rm -rf "$FT_DIR" "$FT_DIR2"
+
 echo "== tpurun multi-node smoke (2 simulated hosts x 2 ranks, shared coordinator) =="
 # The mpirun -H host1:2,host2:2 analog (docs/running.md): two launcher
 # invocations on localhost forming one world of 4 over the coordinator.

@@ -58,6 +58,7 @@ from .optimizer import (  # noqa: F401
 )
 from . import callbacks  # noqa: F401
 from . import data  # noqa: F401
+from . import elastic  # noqa: F401
 from . import hooks  # noqa: F401
 from .hooks import BroadcastGlobalVariablesHook  # noqa: F401
 from . import models  # noqa: F401
@@ -74,4 +75,5 @@ from .exceptions import (  # noqa: F401
     FailedPreconditionError,
     TransportError,
     StalledError,
+    WorkerFailureError,
 )

@@ -25,7 +25,8 @@ from typing import Optional
 import numpy as np
 
 from ..exceptions import (FailedPreconditionError, StalledError,
-                          TransportError)
+                          TransportError, WorkerFailureError)
+from ..testing import faults as _faults
 from ..utils import config as _config
 
 _REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
@@ -87,6 +88,13 @@ def _build_and_load() -> ctypes.CDLL:
     lib.hvdcoord_ring_ops.argtypes = []
     lib.hvdcoord_ring_bytes_sent.restype = ctypes.c_longlong
     lib.hvdcoord_ring_bytes_sent.argtypes = []
+    # Liveness-plane fault-injection/observability hooks (v6).
+    lib.hvdcoord_mute_heartbeats.restype = None
+    lib.hvdcoord_mute_heartbeats.argtypes = [ctypes.c_int]
+    lib.hvdcoord_coord_mute_acks.restype = None
+    lib.hvdcoord_coord_mute_acks.argtypes = [ctypes.c_int]
+    lib.hvdcoord_aborted.restype = ctypes.c_int
+    lib.hvdcoord_aborted.argtypes = []
     return lib
 
 
@@ -128,8 +136,19 @@ class CoordClient:
             raise TransportError(
                 "multi-process world without HVD_COORD_ADDR; launch via "
                 "tpurun or set HVD_COORD_ADDR=host:port")
-        host, _, port = addr.partition(":")
-        return cls(rank, size, host or "127.0.0.1", int(port or 29521),
+        host, _, port_s = addr.partition(":")
+        try:
+            port = int(port_s) if port_s else 29521
+        except ValueError:
+            raise ValueError(
+                f"malformed HVD_COORD_ADDR {addr!r}: the port part "
+                f"{port_s!r} is not an integer (expected host:port, e.g. "
+                f"10.0.0.1:29521)") from None
+        if not 1 <= port <= 65535:
+            raise ValueError(
+                f"malformed HVD_COORD_ADDR {addr!r}: port {port} outside "
+                f"1-65535")
+        return cls(rank, size, host or "127.0.0.1", port,
                    timeline=timeline)
 
     # -- eager collectives -------------------------------------------------
@@ -195,6 +214,10 @@ class CoordClient:
             raise ValueError(f"plane must be one of {sorted(planes)}, "
                              f"got {plane!r}")
 
+        # Deterministic fault injection (HVD_FAULT_SPEC coord:delay_ms=N):
+        # no-op unless the spec targets the coordination plane.
+        _faults.coord_delay()
+
         send_payload = not (kind == "broadcast" and self.rank != root_rank)
         data = np.ascontiguousarray(arr) if send_payload else None
 
@@ -206,6 +229,11 @@ class CoordClient:
             data.ctypes.data if data is not None else None,
             data.nbytes if data is not None else 0, planes[plane],
             err, len(err))
+        if rc == 4:
+            # World already aborted (a rank or the coordinator died):
+            # fail fast with the original diagnosis instead of feeding a
+            # dead coordinator and hanging in wait.
+            raise WorkerFailureError(err.value.decode())
         if rc != 0:
             raise TransportError(err.value.decode())
         self._inflight.add(name)
@@ -236,6 +264,12 @@ class CoordClient:
             # mpi_ops.cc:1153-1196; the hard deadline is a TPU-era extra).
             self._stalled.add(handle.name)
             raise StalledError(err.value.decode())
+        if rc == 4:
+            # World abort: a rank died / went silent (or the coordinator
+            # did). The message names the dead party; the collective can
+            # never complete — recovery is a world restart
+            # (tpurun --restarts + horovod_tpu.elastic).
+            raise WorkerFailureError(err.value.decode())
         if rc != 0:
             raise TransportError(err.value.decode())
 
@@ -283,6 +317,22 @@ class CoordClient:
 
     def ring_bytes_sent(self) -> int:
         return int(self._lib.hvdcoord_ring_bytes_sent())
+
+    # -- liveness plane (fault injection + observability) -----------------
+    def aborted(self) -> bool:
+        """Whether the world has aborted (a rank or the coordinator died)."""
+        return bool(self._lib.hvdcoord_aborted())
+
+    def mute_heartbeats(self, mute: bool = True) -> None:
+        """Fault hook: stop this rank's heartbeats while the process (and
+        its socket) stays alive — the coordinator must detect the silence
+        after ``HVD_HEARTBEAT_TIMEOUT`` and abort the world."""
+        self._lib.hvdcoord_mute_heartbeats(1 if mute else 0)
+
+    def mute_coordinator_acks(self, mute: bool = True) -> None:
+        """Fault hook (rank 0 only): stop the coordinator's heartbeat-acks
+        so every client independently detects a dead coordinator."""
+        self._lib.hvdcoord_coord_mute_acks(1 if mute else 0)
 
     def shutdown(self):
         self._lib.hvdcoord_shutdown()

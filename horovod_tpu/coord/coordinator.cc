@@ -113,6 +113,11 @@ enum class RespType : uint8_t {
   kBroadcastRing = 10,
   kAlltoallRing = 11,       // mesh plan: direct pairwise block exchange
   kReducescatterRing = 12,  // ring plan: reduce-scatter phase only
+  // World abort (v6): a rank died (socket closed without a clean shutdown)
+  // or went silent past HVD_HEARTBEAT_TIMEOUT. Broadcast to every
+  // surviving rank so every blocked hvdcoord_wait fails fast with the dead
+  // rank's identity (-> Python WorkerFailureError) instead of hanging.
+  kAbort = 13,
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -199,6 +204,14 @@ enum class MsgTag : uint8_t {
   kResponse = 2,
   kShutdown = 3,
   kHelloAck = 4,
+  // Liveness plane (v6): clients beat every ~HVD_HEARTBEAT_TIMEOUT/4; the
+  // coordinator acks each beat. Either side going silent past the timeout
+  // is a worker/coordinator failure, not a stall — the world ABORTS
+  // (RespType::kAbort) instead of hanging, the failure mode the reference
+  // inherits from MPI (a dead rank wedges MPI_Allreduce forever;
+  // CheckForStalledTensors only *warns*, mpi_ops.cc:1153-1196).
+  kHeartbeat = 5,
+  kHeartbeatAck = 6,
 };
 
 // Wire protocol version; bumped on incompatible frame-layout changes. Both
@@ -208,7 +221,9 @@ enum class MsgTag : uint8_t {
 // mpi_ops.cc:439-449, moved to init time where TPU worlds can check it).
 // v5: ring election extended to alltoall/reducescatter; hello may carry an
 // advertise-address suffix (HOROVOD_RING_ADVERTISE_ADDR).
-constexpr int32_t kProtocolVersion = 5;
+// v6: liveness plane — kHeartbeat/kHeartbeatAck frames and the kAbort
+// response (fail-fast worker-failure detection, HVD_HEARTBEAT_TIMEOUT).
+constexpr int32_t kProtocolVersion = 6;
 
 // ---------------------------------------------------------------------------
 // Env parsing. atoll/atof would silently truncate ("4M" -> 4) or zero out
@@ -754,6 +769,12 @@ class Coordinator {
     // mpi_ops.cc:1295); tunable for latency-sensitive eager workloads.
     tick_ms_ = static_cast<int>(ParseEnvI64("HOROVOD_COORD_TICK_MS", 5));
     if (tick_ms_ < 0) tick_ms_ = 0;
+    // Liveness deadline (seconds; 0 disables). A rank whose last frame —
+    // heartbeat or otherwise — is older than this is declared dead and the
+    // world ABORTS. The Elastic-Horovod-era fix for the reference's
+    // warn-only stall handling (mpi_ops.cc:1153-1196).
+    heartbeat_timeout_ = ParseEnvF64("HVD_HEARTBEAT_TIMEOUT", 30.0);
+    if (heartbeat_timeout_ < 0) heartbeat_timeout_ = 0;
     if (!timeline_path.empty()) timeline_.Open(timeline_path);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
@@ -780,6 +801,12 @@ class Coordinator {
   }
 
   bool ok() const { return ok_; }
+
+  // Deterministic-fault-injection hook (HVD_FAULT_SPEC coord:mute@step=N):
+  // stop acking client heartbeats so every client observes a silent
+  // coordinator and fails over — the only way to exercise the
+  // dead-coordinator detection path without a real network partition.
+  void set_mute_acks(bool m) { mute_acks_.store(m); }
 
  private:
   void Serve() {
@@ -911,6 +938,13 @@ class Coordinator {
     // negotiation latency floor) while letting in-flight batches coalesce.
     std::vector<pollfd> pfds(size_);
     int done_ranks = 0;
+    // Liveness bookkeeping starts once the world is fully formed: any
+    // frame (request, shutdown, heartbeat) from a rank refreshes its
+    // last_seen; a rank silent past HVD_HEARTBEAT_TIMEOUT aborts the
+    // world. done_[] marks ranks that sent a clean kShutdown — their
+    // subsequent disconnect is benign, anyone else's is a worker failure.
+    last_seen_.assign(size_, std::chrono::steady_clock::now());
+    done_.assign(size_, false);
     while (!shutdown_.load()) {
       for (int i = 0; i < size_; i++)
         pfds[i] = {client_fds_[i], POLLIN, 0};
@@ -931,13 +965,34 @@ class Coordinator {
             if (!(pfds[i].revents & POLLIN)) continue;
             std::string body;
             if (!RecvFrame(client_fds_[i], &body)) {
-              // Client gone: coordinated shutdown (mpi_ops.cc:1437-1447).
-              BroadcastShutdown();
+              if (done_[i]) {
+                // Clean-shutdown rank closing its socket: benign. Forget
+                // the fd so poll stops watching it.
+                ::close(client_fds_[i]);
+                client_fds_[i] = -1;
+                continue;
+              }
+              // A rank died mid-run (process killed -> kernel closed its
+              // socket). The reference's analog hangs every other rank
+              // inside MPI forever; here the world fails fast with the
+              // dead rank's identity.
+              BroadcastAbort(i, "disconnected without a clean shutdown "
+                                "(process crashed or was killed?)");
               return;
             }
+            last_seen_[i] = std::chrono::steady_clock::now();
             Reader rd(body);
             MsgTag tag = static_cast<MsgTag>(rd.GetU8());
+            if (tag == MsgTag::kHeartbeat) {
+              if (!mute_acks_.load()) {
+                Buf ack;
+                ack.PutU8(static_cast<uint8_t>(MsgTag::kHeartbeatAck));
+                SendFrame(client_fds_[i], send_mu_, ack.str());
+              }
+              continue;
+            }
             if (tag == MsgTag::kShutdown) {
+              done_[i] = true;
               if (++done_ranks == size_) {
                 BroadcastShutdown();
                 return;
@@ -961,6 +1016,7 @@ class Coordinator {
       }
       DrainReady();
       CheckStalls();
+      if (CheckHeartbeats()) return;
     }
   }
 
@@ -1504,6 +1560,48 @@ class Coordinator {
       if (client_fds_[r] >= 0) SendFrame(client_fds_[r], send_mu_, body);
   }
 
+  // Declare the world dead because of `dead_rank`: every surviving rank's
+  // blocked hvdcoord_wait fails fast with the dead rank's identity
+  // (-> WorkerFailureError) instead of hanging on collectives that can
+  // never complete. Sent to the dead rank too when its socket is still up
+  // (alive-but-silent ranks deserve the diagnosis as much as survivors).
+  void BroadcastAbort(int dead_rank, const std::string& why) {
+    Response resp;
+    resp.type = RespType::kAbort;
+    resp.name = "__abort__";
+    std::ostringstream o;
+    o << "worker failure: rank " << dead_rank << " " << why
+      << "; aborting the world — in-flight and future collectives on "
+      << "every rank fail with this error";
+    resp.error = o.str();
+    fprintf(stderr, "hvdcoord: %s\n", resp.error.c_str());
+    std::string body = EncodeResponse(resp);
+    for (int r = 0; r < size_; r++)
+      if (client_fds_[r] >= 0) SendFrame(client_fds_[r], send_mu_, body);
+  }
+
+  // Liveness sweep: a rank (not cleanly shut down) whose last frame is
+  // older than HVD_HEARTBEAT_TIMEOUT is dead or wedged — abort. Returns
+  // true when the world was aborted (the serve loop must exit).
+  bool CheckHeartbeats() {
+    if (heartbeat_timeout_ <= 0) return false;
+    auto now = std::chrono::steady_clock::now();
+    for (int i = 0; i < size_; i++) {
+      if (done_[i] || client_fds_[i] < 0) continue;
+      double silent =
+          std::chrono::duration<double>(now - last_seen_[i]).count();
+      if (silent > heartbeat_timeout_) {
+        std::ostringstream o;
+        o << "went silent (no heartbeat for " << silent
+          << " s > HVD_HEARTBEAT_TIMEOUT=" << heartbeat_timeout_
+          << " s; process wedged or network partitioned?)";
+        BroadcastAbort(i, o.str());
+        return true;
+      }
+    }
+    return false;
+  }
+
   // CheckForStalledTensors parity (mpi_ops.cc:1153-1196): warn on stderr for
   // tensors waiting > stall_secs with only a subset of ranks ready.
   void CheckStalls() {
@@ -1553,6 +1651,10 @@ class Coordinator {
   int64_t fusion_threshold_;
   double stall_secs_;
   int tick_ms_ = 5;
+  double heartbeat_timeout_ = 30.0;
+  std::atomic<bool> mute_acks_{false};
+  std::vector<std::chrono::steady_clock::time_point> last_seen_;
+  std::vector<bool> done_;
   bool ok_ = true;
   int listen_fd_ = -1;
   std::vector<int> client_fds_;
@@ -1596,6 +1698,11 @@ class Client {
     ring_io_secs_ =
         static_cast<int>(ParseEnvI64("HOROVOD_RING_IO_TIMEOUT", 30));
     if (ring_io_secs_ < 1) ring_io_secs_ = 1;
+    // Liveness deadline, symmetric with the coordinator's: this client
+    // beats every ~timeout/4 and expects acks; no ack for a full timeout
+    // means the coordinator is dead or wedged -> abort locally (0 = off).
+    heartbeat_timeout_ = ParseEnvF64("HVD_HEARTBEAT_TIMEOUT", 30.0);
+    if (heartbeat_timeout_ < 0) heartbeat_timeout_ = 0;
     peer_fds_.assign(size_, -1);
     // Peer-listen socket for the ring data plane (ephemeral port, announced
     // in the hello; the left ring neighbor connects here).
@@ -1626,17 +1733,37 @@ class Client {
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
-    // Retry connect: the coordinator may not be up yet (launcher races).
-    for (int attempt = 0; attempt < 600; attempt++) {
-      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    // Retry connect under a wall-clock budget with bounded exponential
+    // backoff: the coordinator may not be up yet (launcher spawns ranks
+    // concurrently; a restarted world reopens on a fresh port). The old
+    // fixed 50 ms x 600 schedule hammered the host during long restarts
+    // and gave no knob for slow multi-host bring-up.
+    double connect_budget = ParseEnvF64("HVD_COORD_CONNECT_TIMEOUT", 30.0);
+    if (connect_budget < 0) connect_budget = 0;
+    auto cdeadline = std::chrono::steady_clock::now() +
+                     std::chrono::duration<double>(connect_budget);
+    int backoff_ms = 10;
+    for (;;) {
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
         connected_ = true;
         break;
       }
-      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      if (std::chrono::steady_clock::now() >= cdeadline) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+      backoff_ms = std::min(backoff_ms * 2, 1000);
       ::close(fd_);
       fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     }
-    if (!connected_) return;
+    if (!connected_) {
+      std::ostringstream o;
+      o << "could not connect to coordinator at " << host << ":" << port
+        << " within HVD_COORD_CONNECT_TIMEOUT=" << connect_budget
+        << " s (coordinator not started, wrong HVD_COORD_ADDR, or rank 0 "
+        << "crashed during bring-up?)";
+      init_error_ = o.str();
+      return;
+    }
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     int32_t ver = kProtocolVersion;
@@ -1694,6 +1821,10 @@ class Client {
       return;
     }
     recv_thread_ = std::thread(&Client::RecvLoop, this);
+    if (heartbeat_timeout_ > 0) {
+      last_ack_ms_.store(NowMs());
+      hb_thread_ = std::thread(&Client::HeartbeatLoop, this);
+    }
   }
 
  public:
@@ -1720,6 +1851,7 @@ class Client {
       std::lock_guard<std::mutex> l(mu_);
       cv_.notify_all();
     }
+    if (hb_thread_.joinable()) hb_thread_.join();
     if (recv_thread_.joinable()) recv_thread_.join();
     if (fd_ >= 0) {
       ::close(fd_);
@@ -1796,10 +1928,14 @@ class Client {
 
   // Blocks until the named op completes. Returns 0 ok, 1 connection lost,
   // 2 stall deadline exceeded (HOROVOD_STALL_TIMEOUT strict mode; 0=off —
-  // then this blocks forever like the reference, which only warns).
+  // then this blocks forever like the reference, which only warns),
+  // 3 world aborted (a worker or the coordinator died; message in
+  // abort_message()).
   int Wait(const std::string& name, Response* out) {
     std::unique_lock<std::mutex> l(mu_);
-    auto ready = [&] { return completed_.count(name) > 0 || dead_; };
+    auto ready = [&] {
+      return completed_.count(name) > 0 || dead_ || aborted_;
+    };
     if (stall_timeout_secs_ > 0) {
       if (!cv_.wait_for(
               l, std::chrono::duration<double>(stall_timeout_secs_),
@@ -1814,13 +1950,92 @@ class Client {
     } else {
       cv_.wait(l, ready);
     }
-    if (completed_.count(name) == 0) return 1;
-    *out = std::move(completed_[name]);
-    completed_.erase(name);
-    return 0;
+    // Deliver a completed result even under abort: the response arrived
+    // before the failure, so the caller's data is intact.
+    if (completed_.count(name) > 0) {
+      *out = std::move(completed_[name]);
+      completed_.erase(name);
+      return 0;
+    }
+    if (aborted_) return 3;
+    return 1;
   }
 
+  // Whether the world has been aborted (worker/coordinator failure); the
+  // diagnostic names the dead party. Submits and waits fail fast once set.
+  bool aborted() {
+    std::lock_guard<std::mutex> l(mu_);
+    return aborted_;
+  }
+  std::string abort_message() {
+    std::lock_guard<std::mutex> l(mu_);
+    return abort_msg_;
+  }
+
+  // Fault-injection hook (HVD_FAULT_SPEC rank=N:mute@step=S): stop
+  // beating so the coordinator sees this rank go silent while the process
+  // — and its TCP socket — stays alive. The only way to exercise the
+  // heartbeat-timeout path deterministically (a kill also closes the
+  // socket, which trips the faster disconnect path instead).
+  void set_heartbeat_mute(bool m) { hb_mute_.store(m); }
+
  private:
+  static int64_t NowMs() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  // Mark the world dead with a diagnostic and wake every waiter. Runs on
+  // the recv thread (coordinator-sent kAbort) or the heartbeat thread
+  // (missed acks) — first writer wins, the message is never overwritten.
+  void Abort(const std::string& msg) {
+    std::lock_guard<std::mutex> l(mu_);
+    if (!aborted_) {
+      aborted_ = true;
+      abort_msg_ = msg;
+    }
+    cv_.notify_all();
+  }
+
+  // Client side of the liveness plane: beat every ~timeout/4; if the
+  // coordinator has not acked for a full timeout it is dead or wedged —
+  // abort locally so blocked waits fail over instead of hanging (the
+  // symmetric half of the coordinator's CheckHeartbeats). A C++ thread:
+  // keeps beating through long Python-side pauses (GIL-free), so a slow
+  // JAX compile never reads as a dead rank.
+  void HeartbeatLoop() {
+    int64_t interval_ms =
+        static_cast<int64_t>(heartbeat_timeout_ * 1000 / 4);
+    if (interval_ms < 50) interval_ms = 50;
+    if (interval_ms > 2000) interval_ms = 2000;
+    while (!shutdown_.load()) {
+      if (!hb_mute_.load()) {
+        Buf b;
+        b.PutU8(static_cast<uint8_t>(MsgTag::kHeartbeat));
+        b.PutI32(rank_);
+        SendFrame(fd_, send_mu_, b.str());  // EOF surfaces on recv thread
+        int64_t silent_ms = NowMs() - last_ack_ms_.load();
+        if (silent_ms >
+            static_cast<int64_t>(heartbeat_timeout_ * 1000)) {
+          std::ostringstream o;
+          o << "coordinator failure: no heartbeat-ack from rank 0 for "
+            << silent_ms / 1000.0 << " s (> HVD_HEARTBEAT_TIMEOUT="
+            << heartbeat_timeout_ << " s); coordinator process dead or "
+            << "wedged — aborting this rank";
+          fprintf(stderr, "hvdcoord: rank %d: %s\n", rank_,
+                  o.str().c_str());
+          Abort(o.str());
+          return;
+        }
+      }
+      // Sleep in short slices so Shutdown() joins promptly.
+      for (int64_t slept = 0; slept < interval_ms && !shutdown_.load();
+           slept += 25)
+        std::this_thread::sleep_for(std::chrono::milliseconds(25));
+    }
+  }
+
   // -- ring data plane -----------------------------------------------------
   // Chunked ring allreduce (reduce-scatter + allgather) among the clients,
   // the bandwidth-optimal exchange the reference gets from MPI_Allreduce's
@@ -2196,9 +2411,24 @@ class Client {
       if (!RecvFrame(fd_, &body)) break;
       Reader rd(body);
       MsgTag tag = static_cast<MsgTag>(rd.GetU8());
+      if (tag == MsgTag::kHeartbeatAck) {
+        last_ack_ms_.store(NowMs());
+        continue;
+      }
       if (tag != MsgTag::kResponse) break;
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
+      if (resp.type == RespType::kAbort) {
+        // World aborted (a rank died / went silent). Drop the ring
+        // stashes — their plans will never arrive — and fail every
+        // current and future wait with the named dead rank.
+        {
+          std::lock_guard<std::mutex> l(ring_mu_);
+          ring_pending_.clear();
+        }
+        Abort(resp.error);
+        break;
+      }
       if (resp.type == RespType::kResubmitStar) {
         // Mixed straddling-threshold allgather: re-announce with the
         // stashed payload over the star plane.
@@ -2367,6 +2597,10 @@ class Client {
   int64_t ring_threshold_ = 0;
   double stall_timeout_secs_ = 0;
   int ring_io_secs_ = 30;
+  double heartbeat_timeout_ = 30.0;
+  std::thread hb_thread_;
+  std::atomic<bool> hb_mute_{false};
+  std::atomic<int64_t> last_ack_ms_{0};
   int peer_listen_fd_ = -1;
   int peer_port_ = 0;
   // Full-duplex data-plane socket per peer rank (-1 = not established).
@@ -2383,6 +2617,8 @@ class Client {
   std::map<std::string, Response> completed_;
   std::set<std::string> abandoned_;  // stall-timed-out names (guarded by mu_)
   bool dead_ = false;
+  bool aborted_ = false;        // guarded by mu_
+  std::string abort_msg_;       // guarded by mu_
 };
 
 // ---------------------------------------------------------------------------
@@ -2463,6 +2699,13 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
     snprintf(err, errlen, "hvdcoord not initialized");
     return 2;
   }
+  if (G->client->aborted()) {
+    // Fail fast: after a world abort every collective is doomed — a
+    // fresh submit would announce into a dead coordinator and hang the
+    // caller in wait. Surface the original failure instead.
+    snprintf(err, errlen, "%s", G->client->abort_message().c_str());
+    return 4;
+  }
   Request req;
   req.rank = G->rank;
   req.type = static_cast<ReqType>(req_type);
@@ -2499,7 +2742,9 @@ int hvdcoord_submit(const char* name, int req_type, int dtype, int red_op,
 //     and for allgather writes per-rank first dims into sizes_out[size].
 //   1 coordinator-reported validation error (message in err, FailedPrecondition
 //     parity, mpi_ops.cc:1141-1148); 2 transport failure; 3 stall deadline
-//     exceeded (HOROVOD_STALL_TIMEOUT strict mode -> StalledError).
+//     exceeded (HOROVOD_STALL_TIMEOUT strict mode -> StalledError);
+//   4 world aborted — a worker or the coordinator died (message names the
+//     dead party -> WorkerFailureError).
 int hvdcoord_wait(const char* name, void** out, long long* out_nbytes,
                   long long* sizes_out, char* err, int errlen) {
   using namespace hvdcoord;
@@ -2510,6 +2755,10 @@ int hvdcoord_wait(const char* name, void** out, long long* out_nbytes,
   }
   Response resp;
   int wrc = G->client->Wait(name, &resp);
+  if (wrc == 3) {
+    snprintf(err, errlen, "%s", G->client->abort_message().c_str());
+    return 4;
+  }
   if (wrc == 2) {
     snprintf(err, errlen,
              "collective %s exceeded HOROVOD_STALL_TIMEOUT: one or more "
@@ -2573,6 +2822,35 @@ long long hvdcoord_ring_bytes_sent() {
 }
 
 void hvdcoord_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// Deterministic fault-injection hooks (HVD_FAULT_SPEC; testing/faults.py).
+// These simulate SILENT failures — the kind a kill cannot produce because
+// the kernel closes a dead process's sockets (tripping the faster
+// disconnect path). No-ops when the world is not initialized.
+// ---------------------------------------------------------------------------
+
+// Stop (1) / resume (0) this rank's heartbeats while keeping the process
+// and socket alive: the coordinator must declare this rank dead after
+// HVD_HEARTBEAT_TIMEOUT and abort the world.
+void hvdcoord_mute_heartbeats(int mute) {
+  using namespace hvdcoord;
+  if (g()->client) g()->client->set_heartbeat_mute(mute != 0);
+}
+
+// Stop (1) / resume (0) the coordinator's heartbeat-acks (rank 0 only;
+// no-op elsewhere): every client must independently detect the silent
+// coordinator and abort after HVD_HEARTBEAT_TIMEOUT.
+void hvdcoord_coord_mute_acks(int mute) {
+  using namespace hvdcoord;
+  if (g()->coordinator) g()->coordinator->set_mute_acks(mute != 0);
+}
+
+// Whether this rank's world has aborted (1) — test/observability hook.
+int hvdcoord_aborted() {
+  using namespace hvdcoord;
+  return (g()->client && g()->client->aborted()) ? 1 : 0;
+}
 
 void hvdcoord_shutdown() {
   using namespace hvdcoord;

@@ -48,6 +48,29 @@ class TransportError(HorovodError):
     """
 
 
+class WorkerFailureError(TransportError):
+    """A rank (or the coordinator) died or went silent; the world aborted.
+
+    Subclasses :class:`TransportError`: worker death is detected on the
+    transport plane (socket close / missed heartbeats), and pre-existing
+    ``except TransportError`` handlers must keep catching a dead rank —
+    they just lose the per-rank diagnosis the subclass adds.
+
+    Raised by every blocked or future coordination-plane call once the
+    rank-0 coordinator broadcasts an ABORT — because a rank's socket
+    closed without a clean shutdown (process crashed/killed) or a rank
+    went silent past ``HVD_HEARTBEAT_TIMEOUT`` — or when this rank itself
+    stops receiving heartbeat-acks from the coordinator. The message
+    names the dead party.
+
+    The reference has no analog: a dead rank hangs ``MPI_Allreduce``
+    forever and ``CheckForStalledTensors`` only warns
+    (``mpi_ops.cc:1153-1196``). Recovery: exit nonzero, let
+    ``tpurun --restarts N`` relaunch the world, and resume from the last
+    committed :class:`horovod_tpu.elastic.ElasticState`.
+    """
+
+
 class StalledError(HorovodError):
     """A collective waited past the hard stall deadline (strict mode).
 

@@ -30,7 +30,21 @@ import signal
 import socket
 import subprocess
 import sys
+import time
 from typing import List, Optional
+
+# How long a worker gets between terminate() and kill() during teardown —
+# enough for JAX runtimes to flush, short enough that a wedged worker
+# cannot hold the job hostage.
+TERMINATE_GRACE_SECS = 5.0
+
+# After the FIRST worker failure, how long the siblings get to exit on
+# their own before the supervisor terminates them. The coordination
+# plane's ABORT reaches them within milliseconds and each then exits with
+# the named WorkerFailureError — reaping instantly would race that and
+# destroy the diagnosis; only ranks still alive after the grace (wedged,
+# or not blocked in a collective) get the terminate→kill escalation.
+FAILFAST_GRACE_SECS = 3.0
 
 
 def _free_port() -> int:
@@ -63,20 +77,55 @@ def _chips_per_host() -> int:
     return 1
 
 
-def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
-           jax_distributed: bool = False, cpu: bool = False,
-           node_rank: int = 0, nnodes: int = 1,
-           coordinator: Optional[str] = None,
-           extra_env: Optional[dict] = None) -> int:
-    """Spawn ``np_`` local ranks of ``command`` with the world env wired up.
+def _reap(procs: List[subprocess.Popen],
+          grace_secs: float = TERMINATE_GRACE_SECS) -> None:
+    """Terminate-then-kill every still-running worker, and REAP them all.
 
-    Multi-host: run tpurun on every host with the same ``--coordinator
-    host0:port`` and ``--nnodes N``, giving each host its ``--node-rank``
-    (the role of ``mpirun -H host1:4,host2:4``, reference
-    ``docs/running.md:15-45``). World size = nnodes · np_; this host's ranks
-    are ``node_rank·np_ .. node_rank·np_+np_-1``.
+    terminate() alone is not cleanup: a worker blocked in a collective (or
+    ignoring SIGTERM) survives it, and an unreaped child is a zombie
+    holding its pipes open. Escalation: SIGTERM → wait up to
+    ``grace_secs`` → SIGKILL → wait (SIGKILL cannot be ignored, so the
+    final wait always returns).
+    """
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_secs
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            p.wait()
+        except OSError:
+            pass
 
-    Returns the first nonzero exit code (0 if all succeeded).
+
+def _launch_once(np_: int, command: List[str], *,
+                 coord_port: Optional[int], jax_distributed: bool,
+                 cpu: bool, node_rank: int, nnodes: int,
+                 coordinator: Optional[str], extra_env: Optional[dict],
+                 restart_epoch: int) -> "tuple[int, bool]":
+    """One supervised world launch: spawn, watch ALL ranks, fail fast.
+
+    The seed's wait loop blocked on workers in spawn order: rank 3 dying
+    first went unnoticed until ranks 0-2 exited — which, pre-abort, they
+    never did (the reference's dead-rank-hangs-MPI failure mode). Here the
+    supervisor polls every worker; on the FIRST failure it tears the
+    surviving siblings down (terminate → kill escalation) so the job exits
+    nonzero within seconds, not never.
     """
     world = nnodes * np_
     if coordinator:
@@ -86,12 +135,23 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
     else:
         coord_addr = f"127.0.0.1:{coord_port or _free_port()}"
         jd_addr = f"127.0.0.1:{_free_port()}" if jax_distributed else None
-    procs = []
+    procs: List[subprocess.Popen] = []
+    interrupted = {"sig": None}
 
-    def _terminate(signum, frame):
+    def _forward(signum, frame):
+        # Forward the launcher's own termination (Ctrl-C / SIGTERM from a
+        # job scheduler) to every worker; the supervision loop then reaps
+        # with the usual escalation.
+        interrupted["sig"] = signum
         for p in procs:
-            p.terminate()
-    old = signal.signal(signal.SIGTERM, _terminate)
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+
+    old_term = signal.signal(signal.SIGTERM, _forward)
+    old_int = signal.signal(signal.SIGINT, _forward)
 
     try:
         for local_rank in range(np_):
@@ -103,6 +163,9 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
             env["HVD_LOCAL_RANK"] = str(
                 local_rank % max(1, _chips_per_host() if not cpu else np_))
             env["HVD_COORD_ADDR"] = coord_addr
+            # Which (re)launch of the world this is; read by the elastic
+            # recovery API and the fault injector's @epoch condition.
+            env["HVD_RESTART_EPOCH"] = str(restart_epoch)
             if cpu:
                 # CPU testing mode (reference CI: mpirun -np 2 on localhost
                 # CPU-only, .travis.yml:84-91).
@@ -112,17 +175,99 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
                 env["JAX_NUM_PROCESSES"] = str(world)
                 env["JAX_PROCESS_ID"] = str(rank)
             procs.append(subprocess.Popen(command, env=env))
+
+        # Supervision loop: any-order exit detection.
         rc = 0
-        for p in procs:
-            p.wait()
-            if p.returncode and not rc:
-                rc = p.returncode
-        return rc
+        while True:
+            running = 0
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    running += 1
+                elif code and not rc:
+                    rc = code
+            if rc or not running or interrupted["sig"] is not None:
+                break
+            time.sleep(0.05)
+        if rc and running:
+            # Let the world's own abort cascade surface the diagnosis
+            # (WorkerFailureError naming the dead rank) before tearing the
+            # survivors down.
+            deadline = time.monotonic() + FAILFAST_GRACE_SECS
+            while time.monotonic() < deadline and any(
+                    p.poll() is None for p in procs):
+                time.sleep(0.05)
+            running = sum(1 for p in procs if p.poll() is None)
+            if running:
+                sys.stderr.write(
+                    f"tpurun: a worker exited with code {rc}; terminating "
+                    f"{running} surviving rank(s)\n")
+        _reap(procs)
+        if not rc:
+            for p in procs:
+                if p.returncode and not rc:
+                    rc = p.returncode
+        if interrupted["sig"] is not None and not rc:
+            rc = 128 + int(interrupted["sig"])
+        # The interruption flag travels alongside rc: an operator's Ctrl-C
+        # / scheduler SIGTERM must never be mistaken for a worker failure
+        # (which --restarts would relaunch).
+        return rc, interrupted["sig"] is not None
     finally:
-        signal.signal(signal.SIGTERM, old)
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        _reap(procs)
+
+
+def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
+           jax_distributed: bool = False, cpu: bool = False,
+           node_rank: int = 0, nnodes: int = 1,
+           coordinator: Optional[str] = None,
+           extra_env: Optional[dict] = None,
+           restarts: int = 0) -> int:
+    """Spawn ``np_`` local ranks of ``command`` with the world env wired up.
+
+    Multi-host: run tpurun on every host with the same ``--coordinator
+    host0:port`` and ``--nnodes N``, giving each host its ``--node-rank``
+    (the role of ``mpirun -H host1:4,host2:4``, reference
+    ``docs/running.md:15-45``). World size = nnodes · np_; this host's ranks
+    are ``node_rank·np_ .. node_rank·np_+np_-1``.
+
+    Fault tolerance: every launch is supervised — the first failing rank
+    tears down its siblings so a dead rank can never hang the job (the
+    reference's MPI world does exactly that). With ``restarts > 0`` a
+    failed world is relaunched up to ``restarts`` times on a FRESH
+    coordinator port (the dead coordinator's socket may linger in
+    TIME_WAIT) with exponential backoff, exporting ``HVD_RESTART_EPOCH``
+    so workers resume from their last committed
+    :class:`horovod_tpu.elastic.ElasticState` — the Elastic-Horovod role.
+
+    Returns the first nonzero exit code (0 if all succeeded).
+    """
+    rc = 0
+    for epoch in range(restarts + 1):
+        # Restart on a fresh port: the explicit multi-host --coordinator
+        # address is pinned by the operator (every host must agree), but a
+        # local auto-picked port is never reused across epochs.
+        rc, interrupted = _launch_once(
+            np_, command,
+            coord_port=coord_port if epoch == 0 else None,
+            jax_distributed=jax_distributed, cpu=cpu, node_rank=node_rank,
+            nnodes=nnodes, coordinator=coordinator, extra_env=extra_env,
+            restart_epoch=epoch)
+        if interrupted:
+            # Operator interruption (Ctrl-C / scheduler SIGTERM) is a
+            # command to STOP, not a failure to retry — never relaunch.
+            break
+        if rc == 0 or epoch == restarts:
+            break
+        backoff = min(1.0 * (2 ** epoch), 30.0)
+        sys.stderr.write(
+            f"tpurun: world failed with exit code {rc} (restart epoch "
+            f"{epoch}); relaunching in {backoff:.1f}s "
+            f"({restarts - epoch} restart(s) left)\n")
+        time.sleep(backoff)
+    return rc
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -145,6 +290,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--coordinator", default=None,
                         help="host0:port rendezvous shared by all hosts "
                              "(required when nnodes > 1)")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="relaunch the whole world up to N times after "
+                             "a failure (fresh coordinator port, "
+                             "exponential backoff, HVD_RESTART_EPOCH "
+                             "exported); pair with "
+                             "horovod_tpu.elastic.run_with_recovery to "
+                             "resume from the last committed state")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the command to run, e.g. python train.py")
     args = parser.parse_args(argv)
@@ -152,10 +304,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("no command given")
     if args.nnodes > 1 and not args.coordinator:
         parser.error("--nnodes > 1 requires --coordinator host0:port")
+    if args.restarts < 0:
+        parser.error("--restarts must be >= 0")
     return launch(args.np, args.command, coord_port=args.coord_port,
                   jax_distributed=args.jax_distributed, cpu=args.cpu,
                   node_rank=args.node_rank, nnodes=args.nnodes,
-                  coordinator=args.coordinator)
+                  coordinator=args.coordinator, restarts=args.restarts)
 
 
 if __name__ == "__main__":

@@ -189,7 +189,8 @@ def _maybe_init_jax_distributed() -> None:
         return
     # NB: do NOT probe jax.process_count() here — it would initialize the
     # backend single-process and make distributed init impossible.
-    if jax.distributed.is_initialized():
+    from .utils.compat import jax_distributed_is_initialized
+    if jax_distributed_is_initialized():
         return
     try:
         jax.distributed.initialize(coordinator_address=addr,
@@ -233,9 +234,24 @@ def shutdown() -> None:
         if _world is None:
             return
         if _world.coord is not None:
-            _world.coord.shutdown()
+            try:
+                _world.coord.shutdown()
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                # Crash-safe teardown: a dead coordinator (worker failure,
+                # aborted world) must not wedge the rest of the teardown —
+                # the timeline close and world reset below still run, so a
+                # supervised restart starts from a clean slate.
+                import warnings
+                warnings.warn(
+                    f"coordination-plane shutdown failed (coordinator "
+                    f"already dead?): {e!r} — continuing world teardown")
         if _world.timeline is not None:
-            _world.timeline.close()
+            try:
+                _world.timeline.close()
+            except Exception as e:  # noqa: BLE001
+                import warnings
+                warnings.warn(f"timeline close failed: {e!r} — continuing "
+                              f"world teardown")
         _world = None
         # Drop compiled eager-collective executables from the dead world —
         # their cache keys (generation) can never hit again.

@@ -1,0 +1,230 @@
+"""Deterministic fault injection, driven by ``HVD_FAULT_SPEC``.
+
+The fault-tolerance subsystem (heartbeats, world abort, supervised
+restart, elastic recovery) is only trustworthy if every failure path can
+be exercised on CPU in CI — the reference had no way to kill a rank
+deterministically, so its stall handling shipped warn-only and untested.
+This module turns an env spec into precise failures:
+
+    HVD_FAULT_SPEC=rank=2:kill@step=3          # SIGKILL rank 2 at step 3
+    HVD_FAULT_SPEC=rank=1:mute@step=2          # rank 1 goes silent (alive)
+    HVD_FAULT_SPEC=coord:mute@step=2           # coordinator stops acking
+    HVD_FAULT_SPEC=coord:delay_ms=50           # slow coordination plane
+    HVD_FAULT_SPEC=rank=0:exit@step=4@epoch=1  # only on restart epoch 1
+
+Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>`` or
+``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``. Step-scoped actions
+REQUIRE ``@step`` (a clause that could never fire is rejected loudly);
+``delay_ms`` is unconditional — it has no step context and rejects
+``@step``. Every clause takes an optional ``@epoch=<e>`` suffix
+(default 0) matched against ``HVD_RESTART_EPOCH`` — so a kill drill fires
+on the first launch and NOT again after ``tpurun --restarts`` relaunches
+the world.
+
+Actions:
+
+* ``kill``  — ``SIGKILL`` this process: the kernel closes its sockets, the
+  coordinator sees the disconnect and aborts the world (fast path).
+* ``exit``  — ``os._exit(1)``: same, with a nonzero code of our choosing.
+* ``hang``  — sleep forever while heartbeats keep flowing: the *stall*
+  scenario (``HOROVOD_STALL_TIMEOUT`` / stall warnings), not a death.
+* ``mute``  — stop heartbeats, then sleep forever: the process and its
+  socket stay alive but the rank goes silent on the liveness plane — the
+  only way to exercise the ``HVD_HEARTBEAT_TIMEOUT`` abort path (a kill
+  trips the faster disconnect path instead).
+* ``delay_ms=<n>`` — (``coord`` target) sleep ``n`` ms in every
+  coordination-plane submit, simulating a slow/congested control plane.
+* ``mute`` on the ``coord`` target — rank 0 stops acking heartbeats, so
+  every client independently detects a dead coordinator.
+
+Hooks: :func:`step_hook` is called once per training step by
+``Trainer.fit`` and by elastic training loops; :func:`coord_delay` is
+called by ``CoordClient.submit``. Both are near-zero-cost no-ops when
+``HVD_FAULT_SPEC`` is unset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import time
+from typing import List, Optional
+
+ENV_VAR = "HVD_FAULT_SPEC"
+
+_ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    target: str              # "rank" or "coord"
+    rank: Optional[int]      # rank the fault applies to (None for coord)
+    action: str              # one of _ACTIONS
+    step: Optional[int]      # fire at this step (None = unconditional)
+    epoch: int = 0           # fire only on this HVD_RESTART_EPOCH
+    value: int = 0           # delay_ms payload
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``HVD_FAULT_SPEC`` — loud, like every other env knob."""
+
+
+def parse_spec(text: str) -> List[Fault]:
+    faults: List[Fault] = []
+    for clause in filter(None, (c.strip() for c in text.split(","))):
+        target, _, rest = clause.partition(":")
+        rank: Optional[int] = None
+        if target.startswith("rank="):
+            try:
+                rank = int(target[len("rank="):])
+            except ValueError:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: bad rank in clause {clause!r}") from None
+            target = "rank"
+        elif target != "coord":
+            raise FaultSpecError(
+                f"{ENV_VAR}: clause {clause!r} must start with "
+                f"'rank=<r>:' or 'coord:'")
+        if not rest:
+            raise FaultSpecError(f"{ENV_VAR}: clause {clause!r} has no action")
+        parts = rest.split("@")
+        action, step, epoch, value = parts[0], None, 0, 0
+        if action.startswith("delay_ms="):
+            try:
+                value = int(action[len("delay_ms="):])
+            except ValueError:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: bad delay in clause {clause!r}") from None
+            action = "delay_ms"
+        if action not in _ACTIONS:
+            raise FaultSpecError(
+                f"{ENV_VAR}: unknown action {action!r} in clause "
+                f"{clause!r}; expected one of {_ACTIONS}")
+        for cond in parts[1:]:
+            key, _, val = cond.partition("=")
+            try:
+                if key == "step":
+                    step = int(val)
+                elif key == "epoch":
+                    epoch = int(val)
+                else:
+                    raise FaultSpecError(
+                        f"{ENV_VAR}: unknown condition {cond!r} in clause "
+                        f"{clause!r} (expected step=<n> or epoch=<n>)")
+            except ValueError:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: bad condition {cond!r} in clause "
+                    f"{clause!r}") from None
+        if target == "rank" and rank is None:
+            raise FaultSpecError(
+                f"{ENV_VAR}: rank clause {clause!r} missing rank number")
+        if action == "delay_ms" and step is not None:
+            # The delay applies to EVERY submit (there is no step context
+            # inside the coordination-plane client); accepting @step here
+            # would silently drop the condition.
+            raise FaultSpecError(
+                f"{ENV_VAR}: delay_ms does not support @step (clause "
+                f"{clause!r}) — the delay applies to every "
+                f"coordination-plane submit")
+        if action != "delay_ms" and step is None:
+            # step_hook only fires on an exact step match, so a clause
+            # without @step could never fire — a drill that silently
+            # tests nothing. Same loud-validation standard as above.
+            raise FaultSpecError(
+                f"{ENV_VAR}: {action} requires @step=<n> (clause "
+                f"{clause!r}); without it the fault would never fire")
+        faults.append(Fault(target=target, rank=rank, action=action,
+                            step=step, epoch=epoch, value=value))
+    return faults
+
+
+# Parsed-spec cache keyed by the raw env value, so tests can mutate the
+# env between worlds while the hot no-fault path stays one dict lookup.
+_cache: dict = {}
+_fired: set = set()
+
+
+def _active() -> List[Fault]:
+    raw = os.environ.get(ENV_VAR) or ""
+    if raw not in _cache:
+        _cache[raw] = parse_spec(raw) if raw else []
+    return _cache[raw]
+
+
+def _restart_epoch() -> int:
+    from ..utils import config as _config
+    return _config.restart_epoch()
+
+
+def _my_rank() -> int:
+    from .. import runtime
+    from ..utils import config as _config
+    if runtime.is_initialized():
+        return runtime.world().process_index
+    return _config.launcher_rank(default=0)
+
+
+def _fire(fault: Fault) -> None:
+    tag = f"epoch {_restart_epoch()} step {fault.step}"
+    if fault.action == "kill":
+        print(f"[faults] rank {_my_rank()}: SIGKILL at {tag}", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault.action == "exit":
+        print(f"[faults] rank {_my_rank()}: exit(1) at {tag}", flush=True)
+        os._exit(1)
+    elif fault.action in ("hang", "mute"):
+        client = None
+        from .. import runtime
+        if runtime.is_initialized():
+            client = runtime.world().coord
+        if fault.action == "mute":
+            if fault.target == "coord":
+                if client is not None:
+                    print(f"[faults] rank {_my_rank()}: coordinator mutes "
+                          f"heartbeat-acks at {tag}", flush=True)
+                    client.mute_coordinator_acks(True)
+                return  # the coordinator keeps serving; clients abort
+            if client is not None:
+                client.mute_heartbeats(True)
+        print(f"[faults] rank {_my_rank()}: {fault.action} (sleeping "
+              f"forever) at {tag}", flush=True)
+        while True:  # parked until the launcher or the test kills us
+            time.sleep(3600)
+
+
+def step_hook(step: int) -> None:
+    """Fire any fault scoped to this process at training step ``step``.
+
+    Called by ``Trainer.fit`` after each batch and by elastic training
+    loops; a no-op (one dict lookup) unless ``HVD_FAULT_SPEC`` is set.
+    """
+    faults = _active()
+    if not faults:
+        return
+    epoch = _restart_epoch()
+    for i, f in enumerate(faults):
+        if f.action == "delay_ms" or f.step != step or f.epoch != epoch:
+            continue
+        if f.target == "rank" and f.rank != _my_rank():
+            continue
+        if f.target == "coord" and _my_rank() != 0:
+            continue  # the coordinator lives in rank 0's process
+        key = (i, epoch)
+        if key in _fired:
+            continue
+        _fired.add(key)
+        _fire(f)
+
+
+def coord_delay() -> None:
+    """Sleep per ``coord:delay_ms=<n>`` — called from every coordination-
+    plane submit; no-op unless the spec targets the coordinator."""
+    faults = _active()
+    if not faults:
+        return
+    epoch = _restart_epoch()
+    for f in faults:
+        if (f.target == "coord" and f.action == "delay_ms"
+                and f.epoch == epoch):
+            time.sleep(f.value / 1000.0)

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from . import runtime
+from .testing import faults as _faults
 from .training import TrainState, shard_batch
 
 
@@ -43,6 +44,9 @@ class Trainer:
         # input (see horovod_tpu.data).
         self.prefetch = prefetch
         self.history: List[Dict[str, float]] = []
+        # Global step counter across epochs — drives the deterministic
+        # fault-injection hook (testing/faults.py; no-op in production).
+        self._global_step = 0
 
     def _stream(self, data: Iterable):
         from .data import prefetch_to_device, shard_iterator
@@ -91,6 +95,8 @@ class Trainer:
                     for cb in callbacks:
                         cb.on_batch_end(batch_idx)
                     nsteps += 1
+                    _faults.step_hook(self._global_step)
+                    self._global_step += 1
             finally:
                 close = getattr(stream, "close", None)
                 if close is not None:

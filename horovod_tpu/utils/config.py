@@ -52,6 +52,17 @@ def timeline_path() -> str | None:
     return os.environ.get("HOROVOD_TIMELINE") or None
 
 
+def restart_epoch() -> int:
+    """``HVD_RESTART_EPOCH`` — which (re)launch of the world this is;
+    exported by ``tpurun --restarts`` (0 on the first launch / unset).
+    The single parse shared by the elastic recovery API and the fault
+    injector's ``@epoch`` gating — they must always agree."""
+    try:
+        return int(os.environ.get("HVD_RESTART_EPOCH", "0") or 0)
+    except ValueError:
+        return 0
+
+
 def stall_warning_secs() -> float:
     raw = os.environ.get("HOROVOD_STALL_CHECK_TIME")
     if raw:

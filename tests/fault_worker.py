@@ -1,0 +1,92 @@
+"""Worker for the abort fail-fast drill (test_fault_tolerance.py).
+
+Rank 1 completes one collective, lingers, then dies abruptly. Rank 0
+must observe, in order:
+
+1. a ``StalledError`` for a tensor only it announced (strict stall mode);
+2. a ``WorkerFailureError`` NAMING rank 1 once the coordinator sees the
+   death — instead of the reference's forever-hang;
+3. fail-fast on reuse: resubmitting the stalled name still raises the
+   ValueError immediately, and a fresh-name collective raises
+   ``WorkerFailureError`` immediately (no new negotiation, no hang).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.exceptions import (StalledError,  # noqa: E402
+                                    WorkerFailureError)
+
+
+def main():
+    hvd.init()
+    r = hvd.rank()
+    x = jnp.ones((4,), jnp.float32)
+
+    hvd.allreduce(x, name="common0")  # both ranks: world is healthy
+
+    if r == 1:
+        time.sleep(4.0)  # outlive rank 0's stall deadline, then die
+        os._exit(1)
+
+    # -- rank 0 ------------------------------------------------------------
+    # 1. Stall: rank 1 never announces this name (HOROVOD_STALL_TIMEOUT=2
+    #    is set by the test for rank 0 only).
+    try:
+        hvd.allreduce(x, name="lonely")
+        raise AssertionError("expected StalledError for 'lonely'")
+    except StalledError:
+        print("rank 0: STALL OK", flush=True)
+
+    # 2. Abort: once rank 1 dies, the coordinator broadcasts ABORT and the
+    #    blocked/next wait raises WorkerFailureError naming rank 1.
+    deadline = time.monotonic() + 30.0
+    failure = None
+    i = 0
+    while time.monotonic() < deadline:
+        try:
+            hvd.allreduce(x, name=f"post_{i}")
+            i += 1
+        except StalledError:
+            continue  # rank 1 still alive but asleep — retry
+        except WorkerFailureError as e:
+            failure = e
+            break
+    assert failure is not None, "never observed the world abort"
+    assert "rank 1" in str(failure), failure
+    print("rank 0: ABORT OK", flush=True)
+
+    # 3a. Stalled-name reuse still fails fast (ValueError, not a hang) —
+    #     same public-API path, so the name mangles identically.
+    t0 = time.monotonic()
+    try:
+        hvd.allreduce(x, name="lonely")
+        raise AssertionError("stalled-name resubmit must fail")
+    except ValueError as e:
+        assert "StalledError" in str(e), e
+    assert time.monotonic() - t0 < 2.0, "stalled-name check was not fast"
+
+    # 3b. Fresh-name collective after abort fails fast with the original
+    #     worker-failure diagnosis (submit-side short circuit).
+    t0 = time.monotonic()
+    try:
+        hvd.allreduce(x, name="fresh_after_abort")
+        raise AssertionError("post-abort collective must fail")
+    except WorkerFailureError as e:
+        assert "rank 1" in str(e), e
+    assert time.monotonic() - t0 < 5.0, "post-abort submit was not fast"
+    print("rank 0: FAULT OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

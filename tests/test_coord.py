@@ -406,6 +406,17 @@ def _wait_port_listening(port: int, timeout: float = 60.0) -> None:
     raise TimeoutError(f"nothing listening on port {port}")
 
 
+def _proto_version() -> int:
+    """Current wire-protocol version, read from the one source of truth
+    (coordinator.cc kProtocolVersion) so raw-hello tests track bumps."""
+    import re
+    src_path = os.path.join(os.path.dirname(HERE),
+                            "horovod_tpu", "coord", "coordinator.cc")
+    with open(src_path) as f:
+        return int(re.search(r"kProtocolVersion\s*=\s*(\d+)", f.read())
+                   .group(1))
+
+
 def test_stray_client_does_not_kill_coordinator():
     """A junk/duplicate/out-of-range hello must be rejected without killing
     the accept loop: the real world still forms and completes collectives."""
@@ -418,14 +429,15 @@ def test_stray_client_does_not_kill_coordinator():
         # Out-of-range rank, duplicate rank, wrong world size, wrong
         # protocol version, a stale 12-byte v2 hello, and a junk frame —
         # each must be rejected with a hello-ack naming the reason, without
-        # hurting the real world. (v5 hello: rank, size, version, peer_port
+        # hurting the real world. (hello: rank, size, version, peer_port
         # [+ optional advertise-address suffix])
-        hellos = (struct.pack("<iiii", 99, 2, 5, 0),  # out-of-range rank
-                  struct.pack("<iiii", 0, 2, 5, 0),   # duplicate rank 0
-                  struct.pack("<iiii", 1, 5, 5, 0),   # world-size mismatch
-                  struct.pack("<iiii", 1, 2, 99, 0),  # protocol mismatch
-                  struct.pack("<iii", 1, 2, 2),       # old-build 12B hello
-                  b"xx")                              # junk
+        ver = _proto_version()
+        hellos = (struct.pack("<iiii", 99, 2, ver, 0),  # out-of-range rank
+                  struct.pack("<iiii", 0, 2, ver, 0),   # duplicate rank 0
+                  struct.pack("<iiii", 1, 5, ver, 0),   # world-size mismatch
+                  struct.pack("<iiii", 1, 2, 99, 0),   # protocol mismatch
+                  struct.pack("<iii", 1, 2, 2),        # old-build 12B hello
+                  b"xx")                               # junk
         for hello in hellos:
             try:
                 s = socket_mod.create_connection(("127.0.0.1", port),
@@ -972,7 +984,7 @@ def test_malformed_advertise_addr_rejected_at_hello():
     for bad in (b"evil-host.example:1234",   # hostname, not an IPv4 literal
                 b"10.0.0.1:notaport",        # unparsable port
                 b"10.0.0.1:99999"):          # port out of uint16 range
-        hello = struct.pack("<iiii", 1, 2, 5, 12345) + bad
+        hello = struct.pack("<iiii", 1, 2, _proto_version(), 12345) + bad
         s = socket_mod.create_connection(("127.0.0.1", port), timeout=5)
         s.sendall(struct.pack("<Q", len(hello)) + hello)
         s.settimeout(10)

@@ -1,0 +1,240 @@
+"""Fault-tolerance drills: injected rank death, heartbeat-silence abort,
+fail-fast after ABORT, launcher supervision/escalation, and
+checkpoint-recovery restart equivalence.
+
+The reference's failure story is the motivation: a dead rank hangs
+``MPI_Allreduce`` forever and ``CheckForStalledTensors`` only warns
+(``mpi_ops.cc:1153-1196``). Every test here runs with a hard deadline —
+a regression that reintroduces the hang FAILS instead of wedging CI.
+"""
+
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+ELASTIC_WORKER = os.path.join(HERE, "elastic_worker.py")
+FAULT_WORKER = os.path.join(HERE, "fault_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _tpurun(np_, worker, *, env=None, extra_args=(), timeout=240):
+    full_env = dict(os.environ, PYTHONPATH="", XLA_FLAGS="")
+    full_env.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.launcher", "-np", str(np_),
+         "--cpu", *extra_args, sys.executable, worker],
+        cwd=ROOT, env=full_env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# (a) injected rank death: all ranks exit nonzero within the deadline.
+# ---------------------------------------------------------------------------
+
+def test_killed_rank_aborts_world_no_hang(tmp_path):
+    """SIGKILL rank 2 of 4 at step 3: the coordinator must broadcast an
+    ABORT naming rank 2 and every rank must exit (nonzero) promptly —
+    the communicate() deadline IS the no-hang assertion."""
+    t0 = time.monotonic()
+    out = _tpurun(
+        4, ELASTIC_WORKER,
+        env={"HVD_FAULT_SPEC": "rank=2:kill@step=3",
+             "HVD_ELASTIC_DIR": str(tmp_path),
+             "HVD_HEARTBEAT_TIMEOUT": "10",
+             "HVD_TOTAL_STEPS": "6"},
+        timeout=180)
+    elapsed = time.monotonic() - t0
+    assert out.returncode != 0, out.stdout + out.stderr
+    combined = out.stdout + out.stderr
+    assert "worker failure: rank 2" in combined, combined
+    # Well under HVD_HEARTBEAT_TIMEOUT + 10 s once startup is discounted:
+    # death is detected via the disconnect path, not the heartbeat sweep.
+    # (The bound is generous for a loaded 2-core CI host where 4 JAX
+    # processes contend for startup; the reference's behavior here is
+    # literally infinite.)
+    assert elapsed < 150, f"abort took {elapsed:.0f}s — hang regression?"
+    # Nobody should have printed a FINAL line: training never completed.
+    assert "FINAL" not in out.stdout, out.stdout
+
+
+def test_silent_rank_heartbeat_abort(tmp_path):
+    """A rank that goes SILENT (heartbeats muted, process alive) must be
+    declared dead after HVD_HEARTBEAT_TIMEOUT — the path a plain kill
+    cannot exercise because the kernel closes a dead process's socket."""
+    out = _tpurun(
+        2, ELASTIC_WORKER,
+        env={"HVD_FAULT_SPEC": "rank=1:mute@step=1",
+             "HVD_ELASTIC_DIR": str(tmp_path),
+             "HVD_HEARTBEAT_TIMEOUT": "5",
+             "HVD_TOTAL_STEPS": "4"},
+        timeout=180)
+    assert out.returncode != 0, out.stdout + out.stderr
+    combined = out.stdout + out.stderr
+    assert "went silent" in combined, combined
+    assert "worker failure: rank 1" in combined, combined
+
+
+# ---------------------------------------------------------------------------
+# (b) fail-fast after ABORT (and stalled-name reuse stays fail-fast).
+# ---------------------------------------------------------------------------
+
+def test_abort_fail_fast_and_stalled_name_reuse():
+    """Direct two-rank world (no launcher, so rank 0 is free to finish its
+    checks after rank 1 dies): rank 0 must see StalledError, then a
+    WorkerFailureError naming rank 1, and every later submit must fail
+    fast instead of hanging."""
+    port = _free_port()
+    base = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+                HVD_SIZE="2", HVD_COORD_ADDR=f"127.0.0.1:{port}",
+                HVD_HEARTBEAT_TIMEOUT="30")
+    procs = []
+    for rank in range(2):
+        env = dict(base, HVD_RANK=str(rank))
+        if rank == 0:
+            env["HOROVOD_STALL_TIMEOUT"] = "2"
+        procs.append(subprocess.Popen(
+            [sys.executable, FAULT_WORKER], cwd=ROOT, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert procs[0].returncode == 0, outs[0]
+    assert procs[1].returncode == 1, outs[1]  # deliberate os._exit(1)
+    for marker in ("STALL OK", "ABORT OK", "FAULT OK"):
+        assert marker in outs[0], outs[0]
+
+
+# ---------------------------------------------------------------------------
+# launcher supervision: sibling teardown + terminate->kill escalation.
+# ---------------------------------------------------------------------------
+
+def test_launcher_kills_sigterm_ignoring_sibling():
+    """Worker rank 0 fails immediately; rank 1 IGNORES SIGTERM and sleeps.
+    The supervisor must escalate to SIGKILL after the grace period and
+    return promptly — the seed's terminate()-only cleanup left such a
+    worker running forever."""
+    from horovod_tpu import launcher
+    script = (
+        "import os, signal, time\n"
+        "if os.environ['HVD_RANK'] == '0':\n"
+        "    raise SystemExit(3)\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "time.sleep(120)\n"
+    )
+    t0 = time.monotonic()
+    rc = launcher.launch(2, [sys.executable, "-c", script], cpu=True)
+    elapsed = time.monotonic() - t0
+    assert rc == 3
+    assert elapsed < launcher.TERMINATE_GRACE_SECS + 15, (
+        f"supervision took {elapsed:.0f}s — escalation broken?")
+
+
+# ---------------------------------------------------------------------------
+# (c) checkpoint-recovery restart: final params match an uninterrupted run.
+# ---------------------------------------------------------------------------
+
+def _final_lines(stdout: str):
+    return dict(re.findall(r"rank (\d+)/\d+: (FINAL [0-9.]+ step \d+)",
+                           stdout))
+
+
+def test_run_with_recovery_matches_uninterrupted(tmp_path):
+    """Kill rank 1 at step 3, relaunch once (tpurun --restarts 1), resume
+    from the committed step: the final params must be bit-identical to an
+    uninterrupted run (the elastic acceptance drill)."""
+    steps_env = {"HVD_TOTAL_STEPS": "6", "HVD_HEARTBEAT_TIMEOUT": "10"}
+
+    clean = _tpurun(
+        2, ELASTIC_WORKER,
+        env=dict(steps_env, HVD_ELASTIC_DIR=str(tmp_path / "clean")),
+        timeout=240)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    clean_final = _final_lines(clean.stdout)
+    assert set(clean_final) == {"0", "1"}, clean.stdout
+
+    faulty = _tpurun(
+        2, ELASTIC_WORKER,
+        env=dict(steps_env,
+                 HVD_ELASTIC_DIR=str(tmp_path / "faulty"),
+                 HVD_FAULT_SPEC="rank=1:kill@step=3"),
+        extra_args=("--restarts", "1"),
+        timeout=300)
+    assert faulty.returncode == 0, faulty.stdout + faulty.stderr
+    combined = faulty.stdout + faulty.stderr
+    assert "worker failure: rank 1" in combined, combined
+    assert "resumed from committed step" in faulty.stdout, faulty.stdout
+    faulty_final = _final_lines(faulty.stdout)
+    assert faulty_final == clean_final, (
+        f"recovered run diverged:\nclean={clean_final}\n"
+        f"faulty={faulty_final}")
+
+
+# ---------------------------------------------------------------------------
+# unit-level satellites: fault-spec parsing, from_env validation.
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parser():
+    from horovod_tpu.testing import faults
+    spec = faults.parse_spec(
+        "rank=2:kill@step=5, coord:delay_ms=500, "
+        "rank=0:mute@step=3@epoch=1, coord:mute@step=2")
+    assert [f.action for f in spec] == ["kill", "delay_ms", "mute", "mute"]
+    assert spec[0].rank == 2 and spec[0].step == 5 and spec[0].epoch == 0
+    assert spec[1].target == "coord" and spec[1].value == 500
+    assert spec[2].epoch == 1
+    assert spec[3].target == "coord" and spec[3].step == 2
+    for bad in ("rank:kill@step=1", "rank=x:kill@step=1", "rank=1:boom",
+                "coord:delay_ms=abc", "rank=1:kill@banana=2", "rank=1:",
+                "coord:delay_ms=50@step=3",  # delay has no step context
+                "rank=1:kill",               # step-scoped but no @step:
+                "coord:mute@epoch=1"):       # could never fire
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+
+def test_from_env_malformed_addr(monkeypatch):
+    from horovod_tpu.coord.client import CoordClient
+    monkeypatch.setenv("HVD_COORD_ADDR", "127.0.0.1:notaport")
+    with pytest.raises(ValueError, match="not an integer"):
+        CoordClient.from_env(rank=0, size=2)
+    monkeypatch.setenv("HVD_COORD_ADDR", "127.0.0.1:99999")
+    with pytest.raises(ValueError, match="outside"):
+        CoordClient.from_env(rank=0, size=2)
+
+
+def test_sigint_forwarded_to_workers():
+    """Ctrl-C on tpurun must tear the workers down (SIGINT handling —
+    the seed only handled SIGTERM)."""
+    script = "import time\ntime.sleep(120)\n"
+    env = dict(os.environ, PYTHONPATH="")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.launcher", "-np", "2", "--cpu",
+         sys.executable, "-c", script],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    time.sleep(3.0)  # let it spawn the workers
+    p.send_signal(signal.SIGINT)
+    t0 = time.monotonic()
+    out, _ = p.communicate(timeout=30)
+    assert time.monotonic() - t0 < 25
+    assert p.returncode != 0, out
