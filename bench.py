@@ -137,14 +137,21 @@ def _build_model(cfg):
     """Benchmark models use local (per-replica) BatchNorm — the reference /
     Goyal configuration; cross-replica BN is opt-in via axis_name."""
     name = cfg["model"]
+    # The HVD_FUSED_PARTS sweep (docs/benchmarks.md r5) enters here, at
+    # model CONSTRUCTION — as a module attribute it keys the jit cache
+    # and is uniform across ranks, which a trace-time env read was not.
+    fused_parts = tuple(os.environ.get(
+        "HVD_FUSED_PARTS", "reduce,expand,shortcut").split(","))
     if name == "resnet50":
         return models.resnet50(num_classes=cfg["classes"],
                                dtype=jnp.bfloat16,
-                               conv_backend=cfg.get("conv_backend", "xla"))
+                               conv_backend=cfg.get("conv_backend", "xla"),
+                               fused_parts=fused_parts)
     if name == "resnet101":
         return models.resnet101(num_classes=cfg["classes"],
                                 dtype=jnp.bfloat16,
-                                conv_backend=cfg.get("conv_backend", "xla"))
+                                conv_backend=cfg.get("conv_backend", "xla"),
+                                fused_parts=fused_parts)
     if name == "vgg16":
         return models.vgg16(num_classes=cfg["classes"], dtype=jnp.bfloat16)
     if name == "inception3":

@@ -118,6 +118,23 @@ def report(events, steps_per_call, requested_dispatches):
         o[3] = cat
         o[4] += 1
 
+    # A trace with no per-op device track (CPU backend, or a TPU plugin
+    # that dropped the 'XLA Ops' thread) yields busy == 0; a trace that
+    # missed every module dispatch yields k == 0. Either way every
+    # per-step figure below would divide by zero — fail with the remedy
+    # instead of a bare ZeroDivisionError.
+    if k == 0:
+        raise SystemExit(
+            "profile_step: trace captured 0 dispatches of the step on the "
+            "'XLA Modules' track — the profiler likely started after the "
+            "run or the buffer dropped them; re-run with more --steps or "
+            "on a quieter host")
+    if busy == 0:
+        raise SystemExit(
+            "profile_step: no per-op device time on the 'XLA Ops' track — "
+            "this tool needs the TPU profiler plugin's device events "
+            "(JAX_PLATFORMS=cpu traces carry none); run on a real TPU, or "
+            "use bench.py for host-side wall-clock numbers")
     env = (t_max - t_min) / 1e12
     print(f"device busy: {busy/1e12/k*1e3:.2f} ms/step "
           f"(envelope {env/k*1e3:.2f}); idle = {(env - busy/1e12)/k*1e3:.2f} ms")

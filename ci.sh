@@ -35,11 +35,21 @@ run_cpu python examples/transformer_lm.py --dp 2 --pp 2 --tp 2 --steps 12 --seq 
 run_cpu python examples/imagenet_resnet50.py --epochs 1 --image 32 --batch-per-chip 4 \
   --ckpt-dir "$(mktemp -d)"
 
+echo "== serving smoke: warm the buckets, 200 QPS for 5 s, assert the drop gate =="
+# The serving plane's CI contract (docs/inference.md): the engine must
+# pre-compile every bucket, sustain the target rate with mixed batch
+# sizes, drop ZERO in-deadline requests, and produce a non-empty p50/p99
+# report — serve_bench exits nonzero on any violation.
+run_cpu timeout -k 10 180 python bin/serve_bench.py --qps 200 --duration 5
+
 echo "== striped host reduce (multi-core validation, gated on nproc) =="
 if [ "$(nproc)" -gt 1 ]; then
-  # On a multi-core host, striping must not LOSE to the serial reduce at
+  # On a >=4-core host, striping must not LOSE to the serial reduce at
   # coordinator scale (docs/coordination.md "Star-plane throughput under
-  # load" — the claim striping embodies).
+  # load"); on 2-3 cores the script measures and reports (median of
+  # rounds) without asserting — the 4-way stripe needs 4 cores for the
+  # claim to even apply, and loaded 2-core CI runners were flaking the
+  # bound without any product change.
   python tests/striping_bench.py
 else
   echo "skip: single-core host — striping is neutral by construction here"

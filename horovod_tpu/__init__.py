@@ -18,6 +18,11 @@ plane (multi-process) eagerly. See ``runtime.py`` and ``ops/``.
 
 from .version import __version__  # noqa: F401
 
+# Imported for its side effects FIRST: grafts newer-jax API spellings
+# (jax.shard_map, lax.axis_size, pltpu.CompilerParams) onto older jax
+# installs before any framework module references them.
+from .utils import compat as _compat  # noqa: F401
+
 from .runtime import (  # noqa: F401
     AXIS,
     init,
@@ -62,6 +67,7 @@ from . import elastic  # noqa: F401
 from . import hooks  # noqa: F401
 from .hooks import BroadcastGlobalVariablesHook  # noqa: F401
 from . import models  # noqa: F401
+from . import serve  # noqa: F401
 from . import training  # noqa: F401
 from .trainer import (  # noqa: F401
     Trainer,
@@ -76,4 +82,7 @@ from .exceptions import (  # noqa: F401
     TransportError,
     StalledError,
     WorkerFailureError,
+    ServerOverloadedError,
+    DeadlineExceededError,
+    ServerClosedError,
 )

@@ -71,6 +71,39 @@ class WorkerFailureError(TransportError):
     """
 
 
+class ServerOverloadedError(HorovodError):
+    """The inference server's admission queue is full.
+
+    Raised synchronously by :meth:`horovod_tpu.serve.Engine.submit` when
+    the bounded request queue is at capacity — the load-shedding half of
+    the serving backpressure contract (:mod:`horovod_tpu.serve`). Callers
+    should treat it as retryable after backoff (HTTP 503 semantics; the
+    bundled HTTP front end maps it exactly there). The reference has no
+    serving plane; this extends the taxonomy the same way
+    :class:`StalledError` extends the collective plane.
+    """
+
+
+class DeadlineExceededError(HorovodError):
+    """A queued inference request's deadline expired before execution.
+
+    Delivered through the request's future (never raised on the engine
+    thread): the batcher drops expired requests at dequeue so a stale
+    request cannot occupy a batch slot that an in-deadline request needs.
+    Maps to HTTP 504 in the bundled front end.
+    """
+
+
+class ServerClosedError(HorovodError):
+    """The inference server is shut down (or shutting down).
+
+    Raised by ``submit`` after ``shutdown()`` began, and delivered to any
+    still-pending futures when a shutdown is NOT a graceful drain
+    (``shutdown(drain=False)``). Distinct from
+    :class:`ServerOverloadedError` because it is terminal, not retryable.
+    """
+
+
 class StalledError(HorovodError):
     """A collective waited past the hard stall deadline (strict mode).
 

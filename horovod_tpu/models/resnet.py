@@ -162,6 +162,13 @@ class BottleneckBlock(nn.Module):
     norm: ModuleDef = nn.BatchNorm
     act: Callable = nn.relu
     fused: bool = False
+    # Which of the block's 1x1 convs route through the fused kernel — a
+    # measurement sub-knob (per-conv-site attribution of the pallas
+    # -boundary tax, docs/benchmarks.md r5). A module attribute rather
+    # than a trace-time env read so it participates in jit cache keys
+    # and cannot silently diverge across ranks (ADVICE r5); bench.py
+    # maps the HVD_FUSED_PARTS env sweep onto it at model construction.
+    fused_parts: Tuple[str, ...] = ("reduce", "expand", "shortcut")
 
     def _fuse_settings(self):
         """The conv/norm configuration when the fused branch applies, else
@@ -191,13 +198,7 @@ class BottleneckBlock(nn.Module):
                     axis_name=norm_kw.get("axis_name"))
 
     def _fused_call(self, x, st):
-        import os
-        # Experimental sub-knob (measurement tool, not API): which of the
-        # block's 1x1 convs route through the fused kernel. Used to
-        # attribute the pallas-boundary tax per conv site
-        # (docs/benchmarks.md r5 fused-conv experiment).
-        parts = os.environ.get("HVD_FUSED_PARTS",
-                               "reduce,expand,shortcut").split(",")
+        parts = self.fused_parts
         dtype = st["dtype"]
         bn = functools.partial(
             _FoldedBN, use_running_average=False, momentum=st["momentum"],
@@ -341,6 +342,8 @@ class ResNet(nn.Module):
     # layout copies; see docs/benchmarks.md).
     conv_backend: str = "xla"
     fused_stages: Sequence[int] = (0, 1)
+    # Per-site fusion selection forwarded to BottleneckBlock (see there).
+    fused_parts: Sequence[str] = ("reduce", "expand", "shortcut")
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -384,6 +387,7 @@ class ResNet(nn.Module):
                         and self.block_cls is BottleneckBlock
                         and i in self.fused_stages):
                     extra["fused"] = True
+                    extra["fused_parts"] = tuple(self.fused_parts)
                 x = self.block_cls(
                     self.num_filters * 2 ** i, strides=strides,
                     conv=conv, norm=norm, **extra)(x)

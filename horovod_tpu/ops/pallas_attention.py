@@ -19,6 +19,7 @@ by the block).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -266,7 +267,8 @@ def _dqkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     softmax recompute, the dp matmul and the HBM streaming of the backward
     (7 matmuls + 2 exp2 passes per pair across two kernels -> 5 + 1).
     Costs 2·T·D f32 of VMEM (1 MiB per 2048×128) — callers fall back to
-    the split kernels when T exceeds ``_FUSED_BWD_MAX_T``.
+    the split kernels when ``_fused_bwd_fits`` says the residents exceed
+    the per-core VMEM budget.
     """
     qi = pl.program_id(qi_axis)
     kb = pl.program_id(kb_axis)
@@ -382,10 +384,36 @@ def _dqkv_packed_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dqkv_ref[0, :, 2 * d:3 * d] = dv_acc[:].astype(dqkv_ref.dtype)
 
 
-# Above this kv length the fused backward's full-T dk/dv accumulators
-# (2·T·D f32 + the [T, D] output blocks) stop being cheap VMEM residents
-# and the split dq/dkv kernels take over. 8192×128 = 4 MiB of scratch.
-_FUSED_BWD_MAX_T = 8192
+# Per-core VMEM the fused backward may claim (v4/v5 generations carry
+# ~16 MiB/core; override for parts that differ). Read once at import so
+# every rank traces the same graph — a trace-time env read could diverge
+# across ranks (the HVD_FUSED_PARTS lesson, ADVICE r5).
+_VMEM_BUDGET_BYTES = int(os.environ.get("HVD_VMEM_BUDGET_MB", "16")) * 2**20
+
+
+def _fused_bwd_fits(T: int, D: int, itemsize: int, *, bq: int, bk: int,
+                    packed: bool) -> bool:
+    """Whether the fused single-pass backward's VMEM residents fit the
+    per-core budget — the gate deciding fused vs split dq/dkv kernels.
+
+    The fused kernel's full-T dk/dv accumulators make its footprint grow
+    with sequence length, so a static T ceiling (the old
+    ``_FUSED_BWD_MAX_T = 8192``, sized for D=128 bf16) admitted shapes
+    that failed to compile at larger D or f32 and rejected small-D shapes
+    that fit fine. Summing the actual residents instead:
+
+    * scratch: dq_acc [bq, D] + dk/dv accumulators 2×[T, D], all f32;
+    * output block(s), grid-constant so VMEM-resident for a whole
+      (batch, head) visit: packed [T, 3D] vs split dq [bq, D] + full-T
+      dk/dv 2×[T, D], in the input dtype;
+    * streamed input tiles (q/do [bq, D], k/v [bk, D], two [bq, lanes]
+      f32 stat tiles), doubled — Mosaic double-buffers pipelined streams.
+    """
+    scratch = 4 * (bq * D + 2 * T * D)
+    out = (T * 3 * D if packed else (bq + 2 * T) * D) * itemsize
+    tiles = ((2 * bq + 2 * bk) * D * itemsize
+             + 2 * bq * _STAT_LANES * 4)
+    return scratch + out + 2 * tiles <= _VMEM_BUDGET_BYTES
 
 
 # Lane width of the per-row stat tensors (lse, delta) on the wire between
@@ -481,7 +509,7 @@ def _flash_core_bwd(causal, interpret, res, do):
     delta = jnp.broadcast_to(delta, (BH, T, _STAT_LANES))
     qkv_spec_q = pl.BlockSpec((1, bq, D), lambda bh, qi, kb: (bh, qi, 0))
     qkv_spec_k = pl.BlockSpec((1, bk, D), lambda bh, qi, kb: (bh, kb, 0))
-    if T <= _FUSED_BWD_MAX_T:
+    if _fused_bwd_fits(T, D, q.dtype.itemsize, bq=bq, bk=bk, packed=False):
         full = pl.BlockSpec((1, T, D), lambda bh, qi, kb: (bh, 0, 0))
         return pl.pallas_call(
             functools.partial(_dqkv_kernel, causal=causal, bq=bq, bk=bk),
@@ -639,7 +667,7 @@ def _flash_qkv_core_bwd(H, causal, sm_scale, interpret, res, do):
     do_q = pl.BlockSpec((1, bq, D), lambda b, h, qi, kb: (b, qi, h))
     stat_q = pl.BlockSpec((1, bq, _STAT_LANES),
                           lambda b, h, qi, kb: (b * H + h, qi, 0))
-    if T <= _FUSED_BWD_MAX_T:
+    if _fused_bwd_fits(T, D, qkv.dtype.itemsize, bq=bq, bk=bk, packed=True):
         packed = pl.BlockSpec((1, T, 3 * D), lambda b, h, qi, kb: (b, 0, h))
         d_qkv = pl.pallas_call(
             functools.partial(_dqkv_packed_kernel, causal=causal, bq=bq,

@@ -88,3 +88,57 @@ def restore_sharded(directory: str, params_template: Any,
         path, item=template,
         restore_args=jax.tree_util.tree_map(_restore_args, template))
     return restored["params"], restored["opt_state"], int(step)
+
+
+def restore_for_inference(directory: str, step: Optional[int] = None, *,
+                          mesh=None, spec_fn=None) -> Any:
+    """Load a checkpoint's serving state — the restore entry point behind
+    :mod:`horovod_tpu.serve`.
+
+    Reads the newest (or ``step``-selected) ``ckpt_<step>`` under
+    ``directory`` and returns the model *variables* dict the inference
+    ``apply`` consumes: ``{"params": ...}`` plus ``"batch_stats"`` when
+    the checkpoint carries BN statistics. Works on both checkpoint
+    flavors this framework writes — the replicated ``save_checkpoint``
+    TrainState pytree (``{step, params, opt_state, batch_stats}``) and
+    the hybrid-mesh ``save_sharded`` tree (``{params, opt_state}``) —
+    because serving needs neither the optimizer state nor the step: the
+    training-only subtrees are dropped unread rather than restored and
+    discarded.
+
+    With ``mesh`` set, every leaf is placed as a global ``jax.Array``
+    laid out by :func:`horovod_tpu.parallel.mesh.named_sharding_tree`
+    (``spec_fn`` picks per-leaf ``PartitionSpec``s; default fully
+    replicated) — so a model too big for one chip serves sharded across
+    the slice with zero model-code changes. Without ``mesh``, plain host
+    numpy comes back (single-host serving).
+    """
+    import orbax.checkpoint as ocp
+    if step is None:
+        step = latest_checkpoint_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _ckpt_path(directory, int(step))
+    ckptr = ocp.PyTreeCheckpointer()
+    # Structure first (metadata reads no array bytes), then a PARTIAL
+    # restore of just the serving subtrees: for an Adam-style optimizer
+    # the opt_state is ~2x the params, so a full read would triple the
+    # restore I/O and peak host memory of every server start.
+    meta = ckptr.metadata(path)
+    if "params" not in meta:
+        raise ValueError(
+            f"{path} has no 'params' subtree — not a checkpoint this "
+            f"framework wrote (keys: {sorted(meta)})")
+    item = {k: meta[k] for k in ("params", "batch_stats")
+            if meta.get(k) is not None}
+    variables = ckptr.restore(
+        path, item=item, transforms={},
+        restore_args=jax.tree_util.tree_map(lambda _: ocp.RestoreArgs(),
+                                            item))
+    if mesh is None:
+        return variables
+    from .mesh import named_sharding_tree
+    shardings = named_sharding_tree(mesh, variables, spec_fn)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(np.asarray(x), s),
+        variables, shardings)

@@ -53,6 +53,26 @@ def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 
 
+def named_sharding_tree(mesh: Mesh, tree, spec_fn=None):
+    """A tree of ``NamedSharding`` matching ``tree``'s structure.
+
+    ``spec_fn(path, leaf) -> PartitionSpec | None`` picks each leaf's
+    layout (``path`` is the ``jax.tree_util`` key-path tuple); ``None``
+    (and the default ``spec_fn=None``) means fully replicated. This is
+    the placement half of the serve/restore path: training code gets its
+    shardings from the step builder, but a restore-for-inference has no
+    step to inherit from — the checkpoint tree plus a rule is the whole
+    specification.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def shard(path, leaf):
+        spec = spec_fn(path, leaf) if spec_fn is not None else None
+        return NamedSharding(mesh, spec if spec is not None else P())
+
+    return jax.tree_util.tree_map_with_path(shard, tree)
+
+
 def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=()):
     """Gradient sync for spec-sharded parameter trees (runs INSIDE
     shard_map). One implementation shared by both transformer families —

@@ -1,0 +1,34 @@
+"""``horovod_tpu.serve`` — dynamic-batching inference over the sharded
+runtime.
+
+The serving counterpart of the training stack (ROADMAP north star:
+"serves heavy traffic from millions of users"): single requests in,
+padded power-of-two batches through a warm per-bucket compile cache,
+params restored from training checkpoints and laid out over the
+``parallel.mesh`` slice. See ``docs/inference.md`` for the operator
+guide.
+
+    from horovod_tpu import serve
+    variables = serve.restore_for_inference(ckpt_dir)
+    eng = serve.Engine(lambda v, x: model.apply(v, x, train=False),
+                       variables, item_shape=(224, 224, 3))
+    eng.warmup()
+    logits = eng.infer(image)
+"""
+
+from .batcher import (  # noqa: F401
+    Request,
+    RequestQueue,
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+)
+from .engine import SERVE_PHASES, Engine, ServeConfig  # noqa: F401
+from .metrics import ServeMetrics  # noqa: F401
+from .server import HttpServer  # noqa: F401
+from ..parallel.checkpoint import restore_for_inference  # noqa: F401
+from ..exceptions import (  # noqa: F401
+    DeadlineExceededError,
+    ServerClosedError,
+    ServerOverloadedError,
+)

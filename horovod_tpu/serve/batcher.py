@@ -1,0 +1,183 @@
+"""Dynamic batching: request queue, power-of-two buckets, padding.
+
+Single requests arrive on a thread-safe bounded queue and leave as
+padded, *bucketed* batches — the Orca-style iteration-batching shape
+(PAPERS.md lineage) reduced to its stateless-model core:
+
+* **Buckets** are the powers of two up to ``max_batch``. A jit cache
+  keyed on raw batch size would compile one executable per distinct
+  arrival count; rounding up to a bucket caps the cache at
+  ``log2(max_batch)+1`` programs, all pre-compilable by ``warmup()``.
+* **Flush policy**: a batch ships when it reaches ``max_batch``, or when
+  the *oldest* queued request has waited ``batch_timeout_ms`` — latency
+  is bounded by the head-of-line request's wait, not by arrival gaps.
+* **Padding** replicates row 0 rather than writing zeros: padding rows
+  are discarded on the way out, and with row 0 duplicated the padded
+  batch cannot manufacture NaN/Inf rows out of thin air for models with
+  row-coupled numerics (nothing in the contract requires row coupling,
+  but a denormal-heavy zero row is a classic TPU perf trap too).
+
+Everything here is backend-agnostic host code — numpy in, numpy out —
+which is what makes the serving suite runnable under
+``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..exceptions import ServerClosedError, ServerOverloadedError
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """(1, 2, 4, …, max_batch). ``max_batch`` must be a power of two so
+    the top bucket and the flush threshold coincide."""
+    if max_batch < 1 or (max_batch & (max_batch - 1)):
+        raise ValueError(f"max_batch must be a power of two, got {max_batch}")
+    sizes = []
+    b = 1
+    while b <= max_batch:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (callers guarantee n <= max(buckets))."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the top bucket {buckets[-1]}")
+
+
+def pad_rows(rows: Sequence[np.ndarray], bucket: int) -> np.ndarray:
+    """Stack single-example rows into a [bucket, *item_shape] array,
+    replicating row 0 into the padding slots."""
+    n = len(rows)
+    if not 0 < n <= bucket:
+        raise ValueError(f"{n} rows do not fit bucket {bucket}")
+    out = np.stack(list(rows) + [rows[0]] * (bucket - n))
+    return out
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued inference request (a single example)."""
+
+    inputs: np.ndarray
+    future: Any                      # concurrent.futures.Future
+    enqueued_at: float               # time.monotonic()
+    deadline_at: Optional[float]     # absolute monotonic deadline, or None
+    # Filled by the engine at dispatch. ``executed_batch`` (only when
+    # ``ServeConfig.record_executed_batch`` — it pins the padded array
+    # for the future's lifetime) is the [bucket, *item] program input and
+    # ``row`` this request's row in it:
+    # ``apply(variables, executed_batch)[row]`` must be bit-identical to
+    # the served output (the serving correctness contract
+    # tests/test_serve.py pins).
+    bucket: Optional[int] = None
+    executed_batch: Optional[np.ndarray] = None
+    row: Optional[int] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_at is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline_at
+
+
+class RequestQueue:
+    """Bounded FIFO with the dynamic-batching dequeue policy.
+
+    ``put`` is non-blocking admission control: a full queue raises
+    :class:`ServerOverloadedError` immediately (shedding load at the door
+    beats queueing requests that will only expire — the deadline would
+    have burned while waiting).
+    """
+
+    def __init__(self, max_queue: int):
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._max = int(max_queue)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def put(self, req: Request) -> int:
+        """Admit ``req``; returns the resulting queue depth."""
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("inference server is shut down")
+            if len(self._q) >= self._max:
+                raise ServerOverloadedError(
+                    f"request queue full ({self._max}); retry after backoff")
+            self._q.append(req)
+            self._cv.notify()
+            return len(self._q)
+
+    def take_batch(self, max_batch: int,
+                   batch_timeout_ms: float) -> List[Request]:
+        """Block until a batch is due, then return it (possibly empty —
+        an empty list means the queue was closed and fully drained).
+
+        A batch is due when ``max_batch`` requests are queued or the
+        oldest has waited ``batch_timeout_ms``. Expired requests are NOT
+        filtered here — the engine drops them so the failure and the
+        metrics update happen in one place.
+
+        No polling: every producer transition (``put``, ``close``)
+        notifies the condition variable, so the empty-queue wait is
+        untimed (an idle engine costs zero wakeups) and the non-empty
+        wait sleeps exactly to the oldest request's flush deadline — a
+        burst arriving mid-wait wakes it via ``put``'s notify and flushes
+        at ``max_batch`` immediately.
+        """
+        deadline_of_oldest = None
+        with self._cv:
+            while True:
+                if self._q:
+                    now = time.monotonic()
+                    if deadline_of_oldest is None:
+                        deadline_of_oldest = (self._q[0].enqueued_at
+                                              + batch_timeout_ms / 1e3)
+                    if (len(self._q) >= max_batch
+                            or now >= deadline_of_oldest
+                            or self._closed):
+                        # (A closed queue flushes immediately: a graceful
+                        # drain should not serve its tail one flush
+                        # timeout at a time.)
+                        batch = [self._q.popleft()
+                                 for _ in range(min(max_batch,
+                                                    len(self._q)))]
+                        self._cv.notify_all()
+                        return batch
+                    self._cv.wait(deadline_of_oldest - now)
+                else:
+                    deadline_of_oldest = None
+                    if self._closed:
+                        return []
+                    self._cv.wait()
+
+    def close(self) -> List[Request]:
+        """Stop admission. Returns [] (drain mode leaves queued requests
+        for the dispatcher); call ``drain_pending`` to evict instead."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            return []
+
+    def drain_pending(self) -> List[Request]:
+        """Evict and return everything still queued (non-drain shutdown)."""
+        with self._cv:
+            self._closed = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cv.notify_all()
+            return pending

@@ -1,0 +1,340 @@
+"""The inference engine: bucketed batches over a warm per-bucket jit cache.
+
+Composition of the pieces the training side already built (PAPER.md's
+design re-used, zero new model code):
+
+* **Params over the mesh** — restored from a ``save_checkpoint`` pytree
+  (:func:`horovod_tpu.parallel.checkpoint.restore_for_inference`) and
+  laid out by ``NamedSharding`` over a ``parallel.mesh`` mesh, so a model
+  too big for one chip serves across the slice exactly as it trained.
+* **Per-bucket compile cache** — requests are coalesced into power-of-two
+  buckets (:mod:`.batcher`); each bucket is one AOT-compiled executable
+  (``jax.jit(...).lower(...).compile()``), built either lazily on first
+  hit or all at once by :meth:`Engine.warmup` so no user request ever
+  pays a compile.
+* **Backpressure** — bounded admission queue
+  (:class:`~horovod_tpu.exceptions.ServerOverloadedError` at the door),
+  per-request deadlines dropped at dequeue
+  (:class:`~horovod_tpu.exceptions.DeadlineExceededError` through the
+  future), graceful drain on shutdown.
+* **Observability** — :class:`~.metrics.ServeMetrics` snapshot plus the
+  serving phases ``QUEUE → PAD → XLA_EXECUTE → RESPOND`` on the existing
+  :class:`~horovod_tpu.utils.timeline.Timeline` Chrome trace (tensor row
+  ``serve``, op kind ``INFERENCE``).
+
+The dispatch loop is one background thread: with a single accelerator
+program per batch there is nothing to overlap host-side, and one
+consumer keeps the batcher's FIFO semantics trivially correct.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..exceptions import (DeadlineExceededError, ServerClosedError,
+                          ServerOverloadedError)
+from .batcher import (Request, RequestQueue, bucket_for, bucket_sizes,
+                      pad_rows)
+from .metrics import ServeMetrics
+
+# Serving phases on the timeline, in emission order. QUEUE is the wait
+# assembling the batch (flush policy), PAD the host-side bucket/stack,
+# XLA_EXECUTE the device program incl. transfer, RESPOND future delivery.
+SERVE_PHASES = ("QUEUE", "PAD", "XLA_EXECUTE", "RESPOND")
+_TIMELINE_ROW = "serve"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (all host-side; buckets are the only compile surface).
+
+    ``max_batch`` must be a power of two — it is both the top bucket and
+    the flush threshold. ``batch_timeout_ms`` bounds the head-of-line
+    wait: an arriving trickle ships after at most that delay.
+    ``default_deadline_ms`` (None = no deadline) applies to requests that
+    don't carry their own.
+    """
+
+    max_batch: int = 32
+    batch_timeout_ms: float = 5.0
+    max_queue: int = 256
+    default_deadline_ms: Optional[float] = None
+    # Debug/test hook: keep the padded batch each request executed in on
+    # its Request (``future.request.executed_batch``) so callers can
+    # reproduce the exact program input (the bit-identity contract
+    # tests/test_serve.py pins). Off by default — it pins up to a full
+    # [max_batch, *item] array per RETAINED future, real memory at
+    # production shapes.
+    record_executed_batch: bool = False
+
+
+class Engine:
+    """In-process dynamic-batching inference server.
+
+    Args:
+      apply_fn: ``apply_fn(variables, batch) -> outputs`` — typically
+        ``lambda v, x: model.apply(v, x, train=False)``. Outputs may be
+        any pytree of arrays with a leading batch axis.
+      variables: the model variable dict (``{"params": ..., [
+        "batch_stats": ...]}``), e.g. from ``restore_for_inference``.
+        Pre-sharded ``jax.Array`` leaves are served as laid out; host
+        arrays are fine for single-host serving.
+      item_shape / item_dtype: shape/dtype of ONE example (no batch
+        axis). Fixed per engine — one engine serves one signature, the
+        bucketed compile cache depends on it.
+      config: :class:`ServeConfig`.
+      timeline: a :class:`horovod_tpu.utils.timeline.Timeline` to receive
+        the serving phases; defaults to the runtime's timeline when
+        ``horovod_tpu.init()`` ran with ``HOROVOD_TIMELINE`` set.
+    """
+
+    def __init__(self, apply_fn: Callable, variables: Any,
+                 item_shape: Tuple[int, ...], item_dtype: Any = np.float32,
+                 config: ServeConfig = ServeConfig(),
+                 timeline: Optional[Any] = None):
+        self._apply = apply_fn
+        self._variables = variables
+        self._item_shape = tuple(item_shape)
+        self._item_dtype = np.dtype(item_dtype)
+        self._cfg = config
+        self._buckets = bucket_sizes(config.max_batch)
+        self._queue = RequestQueue(config.max_queue)
+        self._metrics = ServeMetrics()
+        self._compiled: Dict[int, Any] = {}
+        self._compile_lock = threading.Lock()
+        # Bucket ids mirrored under their own micro-lock so stats() never
+        # shares the compile critical section — a lazy compile of a big
+        # model holds _compile_lock for seconds-to-minutes, exactly when
+        # an operator polls /stats.
+        self._compiled_ids: set = set()
+        self._stats_lock = threading.Lock()
+        if timeline is None:
+            from .. import runtime
+            if runtime.is_initialized():
+                timeline = runtime.world().timeline
+        self._timeline = timeline
+        self._closed = False
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="hvd-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- compile cache -----------------------------------------------------
+
+    def _compile(self, bucket: int):
+        """AOT-compile the ``bucket``-sized executable (idempotent)."""
+        exe = self._compiled.get(bucket)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(bucket)
+            if exe is None:
+                x = jax.ShapeDtypeStruct((bucket,) + self._item_shape,
+                                         self._item_dtype)
+                exe = (jax.jit(self._apply)
+                       .lower(self._variables, x).compile())
+                self._compiled[bucket] = exe
+                with self._stats_lock:
+                    self._compiled_ids.add(bucket)
+        return exe
+
+    def warmup(self) -> Tuple[int, ...]:
+        """Pre-compile AND pre-execute every bucket before traffic.
+
+        The execution pass matters as much as the compile: it faults in
+        the executable, touches the transfer path, and validates that
+        outputs really carry a leading batch axis — all failures you want
+        at deploy time, not under load. Returns the bucket sizes warmed.
+        """
+        for b in self._buckets:
+            exe = self._compile(b)
+            x = np.zeros((b,) + self._item_shape, self._item_dtype)
+            out = exe(self._variables, x)
+            jax.tree_util.tree_map(
+                lambda a: jax.block_until_ready(a), out)
+            for leaf in jax.tree_util.tree_leaves(out):
+                if not getattr(leaf, "shape", (0,))[:1] == (b,):
+                    raise ValueError(
+                        f"apply_fn output leaf shape {leaf.shape} has no "
+                        f"leading batch axis of {b}; the engine cannot "
+                        f"split it back into per-request rows")
+        return self._buckets
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, inputs: Any,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one example; returns a future resolving to its output
+        row (a numpy pytree). Raises :class:`ServerOverloadedError` when
+        the queue is full, :class:`ServerClosedError` after shutdown.
+
+        The resolved future additionally exposes ``request.bucket`` via
+        the :class:`Request` stored on ``future.request`` — clients and
+        tests can reconstruct the exact executed batch shape.
+        """
+        x = np.asarray(inputs, self._item_dtype)
+        if x.shape != self._item_shape:
+            raise ValueError(
+                f"request shape {x.shape} != engine item shape "
+                f"{self._item_shape} (one example per request)")
+        if deadline_ms is None:
+            deadline_ms = self._cfg.default_deadline_ms
+        now = time.monotonic()
+        req = Request(inputs=x, future=Future(), enqueued_at=now,
+                      deadline_at=(None if deadline_ms is None
+                                   else now + deadline_ms / 1e3))
+        req.future.request = req
+        try:
+            depth = self._queue.put(req)   # raises Closed
+        except ServerOverloadedError:
+            self._metrics.on_overload()
+            raise
+        self._metrics.on_submit(depth)
+        return req.future
+
+    def infer(self, inputs: Any, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> Any:
+        """Synchronous :meth:`submit` (+ ``future.result(timeout)``)."""
+        return self.submit(inputs, deadline_ms).result(timeout)
+
+    def stats(self) -> Dict:
+        """The ``/stats`` snapshot."""
+        snap = self._metrics.snapshot()
+        snap["buckets"] = list(self._buckets)
+        with self._stats_lock:     # lazy _compile inserts race a poll
+            snap["buckets_compiled"] = sorted(self._compiled_ids)
+        snap["max_queue"] = self._cfg.max_queue
+        snap["batch_timeout_ms"] = self._cfg.batch_timeout_ms
+        return snap
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the engine. ``drain=True`` serves everything already
+        queued first; ``drain=False`` fails pending futures with
+        :class:`ServerClosedError`. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain:
+            self._queue.close()
+        else:
+            self._fail_pending()
+        self._thread.join(timeout)
+        # A drain that outlasts the join timeout (a wedged device batch)
+        # must not leave clients blocked forever in future.result():
+        # fail whatever is STILL queued. In-flight requests stay with
+        # the stuck dispatcher — if it ever finishes, their done-state
+        # guards keep it from crashing on a resolved future.
+        if self._thread.is_alive():
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        cancelled = 0
+        for req in self._queue.drain_pending():
+            # A client may have cancel()ed a queued future already —
+            # set_exception on a done future raises InvalidStateError
+            # and would abandon every later pending future.
+            if not req.future.done():
+                req.future.set_exception(ServerClosedError(
+                    "server shut down before execution"))
+                cancelled += 1
+        if cancelled:
+            self._metrics.on_shutdown_cancel(cancelled)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- dispatch ----------------------------------------------------------
+
+    @staticmethod
+    def _phase(tl, name: str):
+        """A serving phase as a scoped timeline activity (no-op without a
+        timeline). Straight-line phases use this; the QUEUE phase stays
+        hand-bracketed in the loop below because its op must be ABANDONED
+        (abort), not ended, when the queue closes mid-wait."""
+        if tl is None:
+            return contextlib.nullcontext()
+        return tl.activity(_TIMELINE_ROW, name)
+
+    def _dispatch_loop(self):
+        tl = self._timeline
+        while True:
+            if tl:
+                tl.start(_TIMELINE_ROW, "INFERENCE")
+                tl.activity_start(_TIMELINE_ROW, "QUEUE")
+            batch = self._queue.take_batch(self._cfg.max_batch,
+                                           self._cfg.batch_timeout_ms)
+            if tl:
+                tl.activity_end(_TIMELINE_ROW)
+            if not batch:               # closed + drained
+                if tl:
+                    tl.abort(_TIMELINE_ROW)
+                return
+            try:
+                self._run_batch(batch, tl)
+            except Exception as e:  # noqa: BLE001 — deliver, don't die
+                if tl:
+                    tl.abort(_TIMELINE_ROW, error=repr(e))
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    def _run_batch(self, batch, tl):
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            # Claiming the future here (PENDING -> RUNNING) also fences
+            # client-side Future.cancel(): a future cancelled while
+            # queued returns False and is dropped — otherwise the later
+            # set_result would raise InvalidStateError and poison every
+            # other request in the batch.
+            if not req.future.set_running_or_notify_cancel():
+                continue
+            if req.expired(now):
+                self._metrics.on_deadline_expired(
+                    (now - req.enqueued_at) * 1e3)
+                req.future.set_exception(DeadlineExceededError(
+                    f"deadline expired after "
+                    f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
+            else:
+                live.append(req)
+        if not live:
+            if tl:
+                tl.end(_TIMELINE_ROW)
+            return
+        with self._phase(tl, "PAD"):
+            bucket = bucket_for(len(live), self._buckets)
+            padded = pad_rows([r.inputs for r in live], bucket)
+            for i, req in enumerate(live):
+                req.bucket, req.row = bucket, i
+                if self._cfg.record_executed_batch:
+                    req.executed_batch = padded
+        with self._phase(tl, "XLA_EXECUTE"):
+            t0 = time.monotonic()
+            exe = self._compile(bucket)
+            out = exe(self._variables, padded)
+            out_np = jax.tree_util.tree_map(np.asarray, out)  # blocks
+            exec_ms = (time.monotonic() - t0) * 1e3
+        with self._phase(tl, "RESPOND"):
+            done = time.monotonic()
+            self._metrics.on_batch(bucket, len(live), exec_ms,
+                                   len(self._queue))
+            for i, req in enumerate(live):
+                row = jax.tree_util.tree_map(lambda a, i=i: a[i], out_np)
+                req.future.set_result(row)
+                self._metrics.on_response(
+                    (done - req.enqueued_at) * 1e3,
+                    (t0 - req.enqueued_at) * 1e3)
+        if tl:
+            first = jax.tree_util.tree_leaves(out_np)[0]
+            tl.end(_TIMELINE_ROW, output=first)

@@ -1,0 +1,145 @@
+"""Serving observability: counters, latency quantiles, batch-fill ratio.
+
+The serving plane's numbers answer three operational questions the
+training-side metrics never ask: *how long does one request take*
+(p50/p99 end-to-end and per-phase), *how full are the batches the chips
+actually execute* (fill ratio — padding is paid compute), and *is the
+server keeping up* (queue depth, overload/deadline drops). Everything is
+exported as one plain-dict snapshot (``Engine.stats()`` / the HTTP
+``/stats`` endpoint) so scrapers need no client library.
+
+Quantiles come from a bounded reservoir (uniform replacement once full):
+serving runs indefinitely, so an unbounded latency list is a slow leak;
+a 4096-sample reservoir pins memory while keeping p99 estimates stable
+at serving rates. The reservoir RNG is a private ``random.Random`` so
+sampling never perturbs user-visible randomness.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+
+class _Reservoir:
+    """Fixed-size uniform reservoir of float samples (Vitter's algorithm R)."""
+
+    def __init__(self, capacity: int = 4096, seed: int = 0):
+        self._cap = int(capacity)
+        self._seen = 0
+        self._vals: List[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, value: float) -> None:
+        self._seen += 1
+        if len(self._vals) < self._cap:
+            self._vals.append(value)
+            return
+        j = self._rng.randrange(self._seen)
+        if j < self._cap:
+            self._vals[j] = value
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._vals:
+            return None
+        vals = sorted(self._vals)
+        # Nearest-rank on the sorted reservoir — monotone in q and exact
+        # for small sample counts (the property tests rely on).
+        idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return vals[idx]
+
+    @property
+    def count(self) -> int:
+        return self._seen
+
+
+class ServeMetrics:
+    """Thread-safe serving counters + latency recorders.
+
+    All mutation goes through one lock: the producers (N submitter
+    threads) and the consumer (the dispatch thread) race on every
+    counter, and serving metrics that tear under load are worse than
+    none — an operator acts on them.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total = 0
+        self.rejected_overload = 0
+        self.expired_deadline = 0
+        self.cancelled_shutdown = 0
+        self.batches_total = 0
+        self.batch_rows_total = 0      # bucket slots executed (incl. padding)
+        self.batch_live_rows_total = 0  # real requests in those slots
+        self.queue_depth = 0
+        self._request_ms = _Reservoir()
+        self._queue_ms = _Reservoir(seed=1)
+        self._execute_ms = _Reservoir(seed=2)
+
+    # -- producers ---------------------------------------------------------
+
+    def on_submit(self, queue_depth: int) -> None:
+        with self._lock:
+            self.requests_total += 1
+            self.queue_depth = queue_depth
+
+    def on_overload(self) -> None:
+        with self._lock:
+            self.rejected_overload += 1
+
+    def on_deadline_expired(self, queue_ms: float) -> None:
+        with self._lock:
+            self.expired_deadline += 1
+            self._queue_ms.add(queue_ms)
+
+    def on_shutdown_cancel(self, n: int) -> None:
+        with self._lock:
+            self.cancelled_shutdown += n
+
+    def on_batch(self, bucket: int, live_rows: int, execute_ms: float,
+                 queue_depth: int) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.batch_rows_total += bucket
+            self.batch_live_rows_total += live_rows
+            self.queue_depth = queue_depth
+            self._execute_ms.add(execute_ms)
+
+    def on_response(self, request_ms: float, queue_ms: float) -> None:
+        with self._lock:
+            self.responses_total += 1
+            self._request_ms.add(request_ms)
+            self._queue_ms.add(queue_ms)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The ``/stats`` dict: plain ints/floats/None only (json-ready)."""
+        with self._lock:
+            fill = (self.batch_live_rows_total / self.batch_rows_total
+                    if self.batch_rows_total else None)
+            return {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "rejected_overload": self.rejected_overload,
+                "expired_deadline": self.expired_deadline,
+                "cancelled_shutdown": self.cancelled_shutdown,
+                "batches_total": self.batches_total,
+                "batch_fill_ratio": fill,
+                # Raw fill-ratio numerator/denominator: consumers drawing
+                # per-interval curves (serve_bench) difference these —
+                # the ratio alone is cumulative and smears intervals.
+                "batch_rows_total": self.batch_rows_total,
+                "batch_live_rows_total": self.batch_live_rows_total,
+                "queue_depth": self.queue_depth,
+                "latency_ms": {
+                    "request_p50": self._request_ms.quantile(0.50),
+                    "request_p99": self._request_ms.quantile(0.99),
+                    "queue_p50": self._queue_ms.quantile(0.50),
+                    "queue_p99": self._queue_ms.quantile(0.99),
+                    "execute_p50": self._execute_ms.quantile(0.50),
+                    "execute_p99": self._execute_ms.quantile(0.99),
+                },
+            }

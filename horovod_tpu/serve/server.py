@@ -1,0 +1,114 @@
+"""Thin HTTP front end over :class:`~horovod_tpu.serve.engine.Engine`.
+
+Stdlib-only (``http.server``) by design: the engine is the product, the
+wire protocol is a demo/testing surface, and the container must not grow
+a web-framework dependency for it. Production fronting belongs on a real
+ingress; this one maps the engine's backpressure contract onto HTTP
+status codes so clients see conventional semantics:
+
+* ``POST /predict`` with ``{"inputs": <nested list>}`` → 200
+  ``{"outputs": ...}``
+* queue full (:class:`ServerOverloadedError`) → **503** (retryable)
+* deadline expired (:class:`DeadlineExceededError`) → **504**
+* shut down (:class:`ServerClosedError`) → 503 with a terminal hint
+* bad shape/JSON → 400
+* ``GET /stats`` → 200, the engine's snapshot dict as JSON
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..exceptions import (DeadlineExceededError, ServerClosedError,
+                          ServerOverloadedError)
+from .engine import Engine
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: Engine = None  # installed by HttpServer
+
+    def log_message(self, *a):  # quiet: the engine's metrics are the log
+        pass
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.split("?", 1)[0].rstrip("/") == "/stats":
+            self._reply(200, self.engine.stats())
+        else:
+            self._reply(404, {"error": f"no such path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no such path {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError(
+                    f"body must be a JSON object, got {type(req).__name__}")
+            x = np.asarray(req["inputs"])
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)   # "abc" -> 400 below
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        try:
+            out = self.engine.infer(x, deadline_ms=deadline_ms)
+            self._reply(200, {"outputs": np.asarray(out).tolist()})
+        except ServerOverloadedError as e:
+            self._reply(503, {"error": str(e), "retryable": True})
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+        except ServerClosedError as e:
+            self._reply(503, {"error": str(e), "retryable": False})
+        except ValueError as e:   # shape mismatch from Engine.submit
+            self._reply(400, {"error": str(e)})
+
+
+class HttpServer:
+    """Serve an :class:`Engine` over HTTP on a background thread.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``) —
+    the test-friendly default.
+    """
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"engine": engine})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HttpServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="hvd-serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -116,6 +116,24 @@ def _resolve_axis_size():
 axis_size = _resolve_axis_size()
 
 
+def _graft_pallas_compiler_params() -> None:
+    """Newer jax renamed ``pltpu.TPUCompilerParams`` →
+    ``pltpu.CompilerParams``; the kernels call the new spelling. Graft it
+    when absent (same policy as the ``jax.shard_map`` graft above).
+    Pallas is optional on exotic builds, so resolution failures just
+    leave the kernels' own ``_HAS_PALLAS`` guard to handle it."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pragma: no cover — no pallas in this build
+        return
+    if (not hasattr(pltpu, "CompilerParams")
+            and hasattr(pltpu, "TPUCompilerParams")):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+_graft_pallas_compiler_params()
+
+
 def jax_distributed_is_initialized() -> bool:
     """``jax.distributed.is_initialized()`` (newer jax) with a fallback to
     the distributed client's global state on versions that predate the

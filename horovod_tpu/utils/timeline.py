@@ -28,6 +28,7 @@ profiler, which this trace is designed to be merged with.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -209,6 +210,38 @@ class Timeline:
             ev["args"] = {"error": error}
         self._states[tensor_name] = _State.UNKNOWN
         self._emit(ev)
+
+    # -- scoped helpers (serving plane) ------------------------------------
+    #
+    # The training-side emitters drive the state machine from callbacks
+    # spread across the dispatch path, so they use the raw begin/end calls
+    # above. The serving plane (horovod_tpu.serve) brackets whole code
+    # regions — QUEUE → PAD → XLA_EXECUTE → RESPOND inside one INFERENCE
+    # op — where scope-exit safety matters more: an exception mid-phase
+    # must not leave a B event unbalanced.
+
+    @contextlib.contextmanager
+    def op(self, tensor_name: str, op_kind: str):
+        """Scoped top-level event; aborts (balanced close + error arg) if
+        the body raises."""
+        self.start(tensor_name, op_kind)
+        try:
+            yield self
+        except BaseException as e:
+            self.abort(tensor_name, error=repr(e))
+            raise
+        else:
+            self.end(tensor_name)
+
+    @contextlib.contextmanager
+    def activity(self, tensor_name: str, name: str):
+        """Scoped nested activity under an open :meth:`op`."""
+        self.activity_start(tensor_name, name)
+        try:
+            yield self
+        finally:
+            if self._states.get(tensor_name) == _State.ACTIVITY:
+                self.activity_end(tensor_name)
 
     def close(self) -> None:
         with self._lock:

@@ -63,11 +63,11 @@ def test_flash_grad_bf16_runs():
 
 
 def test_split_backward_fallback_matches_dense(monkeypatch):
-    """The long-context split dq/dkv kernels (taken when T exceeds
-    _FUSED_BWD_MAX_T, where the fused backward's full-T VMEM accumulators
-    stop fitting) must stay grad-correct."""
+    """The long-context split dq/dkv kernels (taken when _fused_bwd_fits
+    says the fused backward's full-T VMEM accumulators exceed the
+    per-core budget) must stay grad-correct."""
     import horovod_tpu.ops.pallas_attention as pa
-    monkeypatch.setattr(pa, "_FUSED_BWD_MAX_T", 0)
+    monkeypatch.setattr(pa, "_VMEM_BUDGET_BYTES", 0)
     B, T, H, D = 1, 256, 2, 128
     rng = np.random.RandomState(7)
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32) * 0.5

@@ -1,0 +1,518 @@
+"""Serving-plane tests: bucketing/padding correctness (served outputs
+bit-identical to direct ``model.apply``), flush policy, backpressure
+(overload / deadline / drain), ``/stats`` counters, timeline phases, and
+the checkpoint→mesh restore entry point.
+
+All CPU (`-m 'not slow'`): the batching/bucketing plane is
+backend-agnostic host code, and the compiled executables are tiny MLPs.
+Timing style per repo policy: generous waits (``result(30)``), no tight
+elapsed-time asserts — loaded 2-core CI runners must not flake these.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+import horovod_tpu as hvd
+from horovod_tpu import serve
+from horovod_tpu.exceptions import (DeadlineExceededError, ServerClosedError,
+                                    ServerOverloadedError)
+
+ITEM = (12,)
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(5)(x)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    m = _MLP()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1,) + ITEM, jnp.float32))
+    return m, v
+
+
+def _engine(m, v, **cfg_kw):
+    cfg_kw.setdefault("record_executed_batch", True)
+    cfg = serve.ServeConfig(**cfg_kw)
+    return serve.Engine(lambda vv, x: m.apply(vv, x, train=False), v,
+                        item_shape=ITEM, config=cfg)
+
+
+def _rows(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(*ITEM).astype(np.float32) for _ in range(n)]
+
+
+class TestBatcher:
+    def test_bucket_sizes(self):
+        assert serve.bucket_sizes(1) == (1,)
+        assert serve.bucket_sizes(8) == (1, 2, 4, 8)
+        with pytest.raises(ValueError):
+            serve.bucket_sizes(6)
+        with pytest.raises(ValueError):
+            serve.bucket_sizes(0)
+
+    def test_bucket_for(self):
+        buckets = serve.bucket_sizes(16)
+        assert [serve.bucket_for(n, buckets)
+                for n in (1, 2, 3, 4, 5, 9, 16)] == [1, 2, 4, 4, 8, 16, 16]
+        with pytest.raises(ValueError):
+            serve.bucket_for(17, buckets)
+
+    def test_pad_rows_replicates_row0(self):
+        rows = _rows(3)
+        out = serve.pad_rows(rows, 8)
+        assert out.shape == (8,) + ITEM
+        np.testing.assert_array_equal(out[:3], np.stack(rows))
+        for i in range(3, 8):
+            np.testing.assert_array_equal(out[i], rows[0])
+        with pytest.raises(ValueError):
+            serve.pad_rows(rows, 2)
+        with pytest.raises(ValueError):
+            serve.pad_rows([], 2)
+
+
+class TestEngineCorrectness:
+    def test_served_bit_identical_mixed_sizes(self, model_and_vars):
+        """The acceptance contract: across mixed request counts (and so
+        mixed buckets/padding), every served row is BIT-identical to
+        direct ``model.apply`` on the exact executed batch."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=10)
+        try:
+            eng.warmup()
+            futs = []
+            # Three bursts of different sizes with gaps, so multiple
+            # bucket sizes genuinely occur regardless of scheduling.
+            for burst, seed in ((1, 1), (3, 2), (8, 3), (5, 4)):
+                for x in _rows(burst, seed):
+                    futs.append(eng.submit(x))
+                time.sleep(0.08)
+            buckets = set()
+            for f in futs:
+                served = f.result(30)
+                req = f.request
+                buckets.add(req.bucket)
+                direct = np.asarray(
+                    m.apply(v, req.executed_batch, train=False))
+                assert served.tobytes() == direct[req.row].tobytes()
+            assert buckets <= {1, 2, 4, 8}
+            # and padding really happened somewhere (a burst of 3 or 5
+            # can't fill its power-of-two bucket)
+            snap = eng.stats()
+            assert snap["batch_fill_ratio"] <= 1.0
+        finally:
+            eng.shutdown()
+
+    def test_served_close_to_unbatched_apply(self, model_and_vars):
+        """Semantic (not bitwise) check against per-request apply: padding
+        and batching must not change results beyond dtype-level noise."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=4, batch_timeout_ms=5)
+        try:
+            xs = _rows(6, seed=9)
+            outs = [f.result(30) for f in [eng.submit(x) for x in xs]]
+            for x, out in zip(xs, outs):
+                direct = np.asarray(m.apply(v, x[None], train=False))[0]
+                np.testing.assert_allclose(out, direct, rtol=1e-5,
+                                           atol=1e-6)
+        finally:
+            eng.shutdown()
+
+    def test_warmup_precompiles_every_bucket(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8)
+        try:
+            assert eng.stats()["buckets_compiled"] == []
+            assert eng.warmup() == (1, 2, 4, 8)
+            assert eng.stats()["buckets_compiled"] == [1, 2, 4, 8]
+        finally:
+            eng.shutdown()
+
+    def test_warmup_rejects_batchless_output(self, model_and_vars):
+        m, v = model_and_vars
+        cfg = serve.ServeConfig(max_batch=2)
+        eng = serve.Engine(
+            lambda vv, x: jnp.sum(m.apply(vv, x, train=False)), v,
+            item_shape=ITEM, config=cfg)
+        try:
+            with pytest.raises(ValueError, match="leading batch axis"):
+                eng.warmup()
+        finally:
+            eng.shutdown(drain=False)
+
+    def test_submit_shape_validation(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=2)
+        try:
+            with pytest.raises(ValueError, match="item shape"):
+                eng.submit(np.zeros((3, 7), np.float32))
+        finally:
+            eng.shutdown()
+
+
+class TestFlushPolicy:
+    def test_timeout_flush_partial_batch(self, model_and_vars):
+        """Two requests against max_batch=8 must still be answered — the
+        head-of-line timeout flushes the partial batch."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=20)
+        try:
+            futs = [eng.submit(x) for x in _rows(2)]
+            outs = [f.result(30) for f in futs]
+            assert all(o.shape == (5,) for o in outs)
+            # Flushed well under max_batch: padded bucket <= 2 per request
+            assert all(f.request.bucket <= 2 for f in futs)
+            assert eng.stats()["batches_total"] >= 1
+        finally:
+            eng.shutdown()
+
+    def test_full_batch_flushes_without_timeout(self, model_and_vars):
+        """max_batch arrivals flush immediately; a huge batch_timeout_ms
+        must not delay a full bucket (the test would hang otherwise)."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=4, batch_timeout_ms=60_000)
+        try:
+            futs = [eng.submit(x) for x in _rows(4)]
+            outs = [f.result(30) for f in futs]
+            assert len(outs) == 4
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestBackpressure:
+    def test_overload_rejection_and_closed_cancel(self, model_and_vars):
+        m, v = model_and_vars
+        # Dispatcher flushes only at 1s head-of-line age -> the queue
+        # (capacity 2) fills and the door must reject.
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=1000, max_queue=2)
+        try:
+            accepted, rejected = [], 0
+            for x in _rows(8):
+                try:
+                    accepted.append(eng.submit(x))
+                except ServerOverloadedError:
+                    rejected += 1
+            assert rejected >= 1
+            assert len(accepted) >= 2
+            assert eng.stats()["rejected_overload"] == rejected
+        finally:
+            eng.shutdown(drain=False)
+        # Non-drain shutdown fails whatever was still pending...
+        failed = 0
+        for f in accepted:
+            try:
+                f.result(5)
+            except ServerClosedError:
+                failed += 1
+        # ...and submission after shutdown is terminally closed.
+        with pytest.raises(ServerClosedError):
+            eng.submit(_rows(1)[0])
+        snap = eng.stats()
+        assert snap["cancelled_shutdown"] == failed
+
+    def test_deadline_expiry_in_queue(self, model_and_vars):
+        """A 1 ms deadline expires during the 60 ms flush wait: the future
+        gets DeadlineExceededError, the batch never executes it."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=60)
+        try:
+            fut = eng.submit(_rows(1)[0], deadline_ms=1.0)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(30)
+            snap = eng.stats()
+            assert snap["expired_deadline"] == 1
+            assert snap["responses_total"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_default_deadline_from_config(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=60,
+                      default_deadline_ms=1.0)
+        try:
+            with pytest.raises(DeadlineExceededError):
+                eng.infer(_rows(1)[0], timeout=30)
+        finally:
+            eng.shutdown()
+
+    def test_graceful_drain_serves_queued_requests(self, model_and_vars):
+        """shutdown(drain=True) answers everything already admitted, then
+        stops — no request accepted is ever silently dropped."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=5000)
+        futs = [eng.submit(x) for x in _rows(5)]
+        eng.shutdown(drain=True)   # flushes immediately despite the 5 s knob
+        outs = [f.result(10) for f in futs]
+        assert len(outs) == 5 and all(o.shape == (5,) for o in outs)
+        assert eng.stats()["responses_total"] == 5
+        assert not eng._thread.is_alive()
+
+    def test_client_cancel_does_not_poison_batch(self, model_and_vars):
+        """A future cancelled while queued is dropped at dispatch;
+        batch-mates still get their results (a cancelled future would
+        otherwise make set_result raise InvalidStateError into the whole
+        batch)."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=100)
+        try:
+            xs = _rows(3)
+            f0 = eng.submit(xs[0])
+            rest = [eng.submit(x) for x in xs[1:]]
+            cancelled = f0.cancel()
+            outs = [f.result(30) for f in rest]
+            assert len(outs) == 2 and all(o.shape == (5,) for o in outs)
+            if cancelled:       # dispatch may have claimed f0 first
+                assert f0.cancelled()
+            else:
+                assert f0.result(30).shape == (5,)
+        finally:
+            eng.shutdown()
+
+    def test_cancelled_future_survives_nondrain_shutdown(self,
+                                                         model_and_vars):
+        """shutdown(drain=False) with a client-cancelled future in the
+        queue must still fail the OTHER pending futures (a set_exception
+        on the cancelled one would raise InvalidStateError out of
+        shutdown and abandon them)."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=8, batch_timeout_ms=60_000)
+        f0 = eng.submit(_rows(1)[0])
+        f1 = eng.submit(_rows(1, seed=1)[0])
+        assert f0.cancel()
+        eng.shutdown(drain=False)
+        with pytest.raises(ServerClosedError):
+            f1.result(5)
+        assert eng.stats()["cancelled_shutdown"] == 1
+
+    def test_shutdown_idempotent(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=2)
+        eng.shutdown()
+        eng.shutdown()
+
+
+class TestStats:
+    def test_snapshot_counters_and_quantiles(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=4, batch_timeout_ms=5)
+        try:
+            for x in _rows(6):
+                eng.infer(x, timeout=30)
+            snap = eng.stats()
+            assert snap["requests_total"] == 6
+            assert snap["responses_total"] == 6
+            assert snap["batches_total"] >= 2      # 6 requests, buckets <= 4
+            assert 0.0 < snap["batch_fill_ratio"] <= 1.0
+            lat = snap["latency_ms"]
+            assert lat["request_p50"] is not None
+            assert lat["request_p99"] >= lat["request_p50"] > 0
+            assert lat["execute_p50"] > 0
+            assert snap["buckets"] == [1, 2, 4]
+            # json-ready: the /stats wire format must round-trip
+            json.dumps(snap)
+        finally:
+            eng.shutdown()
+
+
+class TestTimeline:
+    def test_serving_phases_emitted(self, model_and_vars, tmp_path):
+        """A served batch appears on the Chrome trace as an INFERENCE op
+        with the QUEUE → PAD → XLA_EXECUTE → RESPOND activities, and the
+        B/E stream stays balanced through engine shutdown."""
+        from horovod_tpu.utils.timeline import Timeline
+        m, v = model_and_vars
+        path = str(tmp_path / "serve.json")
+        tl = Timeline(path)
+        cfg = serve.ServeConfig(max_batch=4, batch_timeout_ms=5)
+        eng = serve.Engine(lambda vv, x: m.apply(vv, x, train=False), v,
+                           item_shape=ITEM, config=cfg, timeline=tl)
+        try:
+            for x in _rows(3):
+                eng.infer(x, timeout=30)
+        finally:
+            eng.shutdown()
+        tl.close()
+        events = json.load(open(path))
+        names = [e["name"] for e in events if e.get("ph") == "B"]
+        assert "INFERENCE" in names
+        for phase in serve.SERVE_PHASES:
+            assert phase in names, (phase, names)
+        depth = {}
+        for e in events:
+            if e.get("ph") == "B":
+                depth[e["pid"]] = depth.get(e["pid"], 0) + 1
+            elif e.get("ph") == "E":
+                depth[e["pid"]] = depth.get(e["pid"], 0) - 1
+                assert depth[e["pid"]] >= 0, events
+        assert all(d == 0 for d in depth.values()), depth
+
+    def test_timeline_scoped_helpers(self, tmp_path):
+        """The op()/activity() contextmanagers close their frames on both
+        the clean and the raising path."""
+        from horovod_tpu.utils.timeline import Timeline
+        path = str(tmp_path / "cm.json")
+        tl = Timeline(path)
+        with tl.op("t", "OP"):
+            with tl.activity("t", "A"):
+                pass
+        with pytest.raises(RuntimeError):
+            with tl.op("t", "OP"):
+                with tl.activity("t", "A"):
+                    raise RuntimeError("boom")
+        tl.close()
+        events = json.load(open(path))
+        b = sum(1 for e in events if e.get("ph") == "B")
+        e_ = sum(1 for e in events if e.get("ph") == "E")
+        assert b == e_ == 4
+
+
+class TestRestoreForInference:
+    def _train_state(self):
+        import optax
+        from horovod_tpu.training import TrainState
+        params = {"dense": {"kernel": jnp.ones((4, 3)),
+                            "bias": jnp.arange(3.0)}}
+        opt = optax.sgd(0.1)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt.init(params),
+                          batch_stats={"bn": {"mean": jnp.ones((3,))}})
+
+    def test_trainer_checkpoint_roundtrip(self, tmp_path):
+        from horovod_tpu.trainer import save_checkpoint
+        st = self._train_state()
+        save_checkpoint(str(tmp_path), st, step=3)
+        save_checkpoint(str(tmp_path), st, step=7)
+        variables = serve.restore_for_inference(str(tmp_path))
+        assert set(variables) == {"params", "batch_stats"}
+        np.testing.assert_array_equal(
+            variables["params"]["dense"]["bias"], np.arange(3.0))
+        # explicit step selection
+        v3 = serve.restore_for_inference(str(tmp_path), step=3)
+        assert set(v3) == {"params", "batch_stats"}
+        # training-only subtrees are dropped, not restored-and-discarded
+        assert "opt_state" not in variables
+
+    def test_sharded_checkpoint_flavor(self, tmp_path):
+        from horovod_tpu.parallel.checkpoint import save_sharded
+        st = self._train_state()
+        save_sharded(str(tmp_path), 2, st.params, st.opt_state)
+        variables = serve.restore_for_inference(str(tmp_path))
+        assert set(variables) == {"params"}   # no batch_stats saved
+        np.testing.assert_array_equal(
+            variables["params"]["dense"]["kernel"], np.ones((4, 3)))
+
+    def test_missing_directory(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            serve.restore_for_inference(str(tmp_path / "nope"))
+
+    def test_mesh_placement_replicated_and_sharded(self, tmp_path):
+        """With a mesh, leaves come back as global jax.Arrays laid out by
+        named_sharding_tree — replicated by default, spec_fn overrides
+        per leaf (the big-model sharded-serving path)."""
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.parallel.mesh import create_hybrid_mesh
+        from horovod_tpu.trainer import save_checkpoint
+        import optax
+        from horovod_tpu.training import TrainState
+        params = {"emb": jnp.arange(32.0).reshape(8, 4),
+                  "bias": jnp.arange(4.0)}
+        st = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                        opt_state=optax.sgd(0.1).init(params))
+        save_checkpoint(str(tmp_path), st, step=1)
+        mesh = create_hybrid_mesh(dp=len(jax.devices()))
+
+        def spec_fn(path, leaf):
+            if leaf.ndim == 2:
+                return P("dp")     # shard the big table over the slice
+            return None            # everything else replicated
+
+        variables = serve.restore_for_inference(str(tmp_path), mesh=mesh,
+                                                spec_fn=spec_fn)
+        emb = variables["params"]["emb"]
+        bias = variables["params"]["bias"]
+        assert isinstance(emb, jax.Array)
+        assert emb.sharding.spec == P("dp")
+        assert bias.sharding.spec == P()
+        np.testing.assert_array_equal(np.asarray(emb), params["emb"])
+        np.testing.assert_array_equal(np.asarray(bias), params["bias"])
+
+    def test_checkpoint_to_engine_end_to_end(self, model_and_vars,
+                                             tmp_path):
+        """The full serving path: train-side save_checkpoint → restore →
+        Engine → served output bit-identical to apply on the restored
+        variables."""
+        import optax
+        from horovod_tpu.trainer import save_checkpoint
+        from horovod_tpu.training import TrainState
+        m, v = model_and_vars
+        st = TrainState(step=jnp.zeros((), jnp.int32), params=v["params"],
+                        opt_state=optax.sgd(0.1).init(v["params"]))
+        save_checkpoint(str(tmp_path), st, step=11)
+        variables = serve.restore_for_inference(str(tmp_path))
+        eng = serve.Engine(
+            lambda vv, x: m.apply(vv, x, train=False), variables,
+            item_shape=ITEM,
+            config=serve.ServeConfig(max_batch=2, batch_timeout_ms=5,
+                                     record_executed_batch=True))
+        try:
+            fut = eng.submit(_rows(1)[0])
+            out = fut.result(30)
+            req = fut.request
+            direct = np.asarray(
+                m.apply(variables, req.executed_batch, train=False))
+            assert out.tobytes() == direct[req.row].tobytes()
+        finally:
+            eng.shutdown()
+
+
+class TestHttpServer:
+    def test_predict_and_stats(self, model_and_vars):
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=4, batch_timeout_ms=5)
+        try:
+            with serve.HttpServer(eng) as srv:
+                url = f"http://{srv.host}:{srv.port}"
+                x = _rows(1)[0]
+                req = urllib.request.Request(
+                    url + "/predict",
+                    data=json.dumps({"inputs": x.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == 200
+                    out = json.loads(resp.read())["outputs"]
+                assert len(out) == 5
+                direct = np.asarray(m.apply(v, x[None], train=False))[0]
+                np.testing.assert_allclose(out, direct, rtol=1e-5,
+                                           atol=1e-6)
+                with urllib.request.urlopen(url + "/stats",
+                                            timeout=30) as resp:
+                    snap = json.loads(resp.read())
+                assert snap["responses_total"] >= 1
+
+            # bad shape -> 400, unknown path -> 404
+            with serve.HttpServer(eng) as srv:
+                url = f"http://{srv.host}:{srv.port}"
+                req = urllib.request.Request(
+                    url + "/predict",
+                    data=json.dumps({"inputs": [[1.0, 2.0]]}).encode())
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=30)
+                assert ei.value.code == 400
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(url + "/nope", timeout=30)
+                assert ei.value.code == 404
+        finally:
+            eng.shutdown()
