@@ -180,7 +180,12 @@ def measure(devices=None, cfg=None) -> float:
         model, jax.random.PRNGKey(0),
         jnp.zeros((cfg["batch_per_chip"],) + x_shape[1:], jnp.float32),
         optax.sgd(cfg.get("lr", 0.1), momentum=0.9))
-    step = training.make_train_step(model, dist_opt)
+    accum = int(cfg.get("accum_steps", 1))
+    if cfg["batch_per_chip"] % accum:
+        raise SystemExit(
+            f"--accum-steps {accum} does not divide the per-chip batch "
+            f"of {cfg['batch_per_chip']}")
+    step = training.make_train_step(model, dist_opt, accum_steps=accum)
 
     # Materialize only local shards (a host-side global batch would be
     # multiple GB at pod scale).
@@ -424,8 +429,22 @@ def main() -> None:
                    help="ResNet conv backend: 'fused' routes the "
                         "bottleneck 1x1 convs through the fused Pallas "
                         "conv+BN+ReLU kernel (ops/pallas_conv.py)")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="in-step gradient accumulation: scan N microbatches "
+                        "inside the compiled step, one fused allreduce per "
+                        "accumulated step (docs/performance.md); the "
+                        "per-chip batch is split, so the global batch per "
+                        "optimizer update is unchanged")
     args = p.parse_args()
+    if args.accum_steps < 1:
+        raise SystemExit(f"--accum-steps must be >= 1, got "
+                         f"{args.accum_steps}")
     if args.model == "transformer_lm":
+        if args.accum_steps > 1:
+            raise SystemExit(
+                "--accum-steps applies to the conv family (the "
+                "make_train_step path); the parallel transformer has its "
+                "own pipeline-microbatching knobs")
         if args.scaling:
             raise SystemExit(
                 "--scaling is not supported for transformer_lm (the conv "
@@ -434,6 +453,7 @@ def main() -> None:
         print(json.dumps(lm_line()))
         return
     cfg = _bench_config(args.model or "resnet50")
+    cfg["accum_steps"] = args.accum_steps
     if args.conv_backend:
         if (args.model or "resnet50") not in ("resnet50", "resnet101"):
             raise SystemExit(
@@ -486,6 +506,7 @@ def main() -> None:
             "unit": "images/sec/chip",
             "vs_baseline": round(per_chip / _baseline_for(cfg["model"]),
                                  3),
+            "accum_steps": int(cfg.get("accum_steps", 1)),
         }))
         return
 
@@ -496,6 +517,7 @@ def main() -> None:
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / _baseline_for(cfg["model"]), 3),
+        "accum_steps": int(cfg.get("accum_steps", 1)),
     }
     tflops = per_chip * TRAIN_GFLOP_PER_IMAGE[cfg["model"]] / 1e3
     line["tflops_per_chip"] = round(tflops, 1)

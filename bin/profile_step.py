@@ -155,6 +155,61 @@ def report(events, steps_per_call, requested_dispatches):
               f"{cat} / {name[:70]}")
 
 
+def timeline_host_report(path):
+    """Host-plane attribution from a ``HOROVOD_TIMELINE`` Chrome trace.
+
+    The device-side tables above say where MXU time goes; this says what
+    the HOST was doing meanwhile: ``H2D`` rows come from the prefetch
+    thread (input staging), ``CKPT_SNAPSHOT``/``CKPT_WRITE`` from the
+    checkpoint path. A run whose summed H2D time approaches its wall clock
+    is input-bound — grow the prefetch depth or the input workers before
+    touching the model; large CKPT_WRITE with small CKPT_SNAPSHOT means
+    async checkpointing is doing its job (the write overlaps training).
+    """
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        events = json.loads(text)
+    except json.JSONDecodeError:
+        # The trace is a terminated JSON array only after Timeline.close();
+        # a still-running or killed run leaves "[{...},\n{...},\n" — apply
+        # the trailing-comma-tolerant completion Chrome's viewer uses.
+        events = json.loads(text.rstrip().rstrip(",") + "]")
+    open_ev = {}
+    totals = collections.defaultdict(lambda: [0.0, 0])  # name -> [us, n]
+    t_min, t_max = float("inf"), 0.0
+    for e in events:
+        if not isinstance(e, dict) or "ph" not in e:
+            continue
+        ts = e.get("ts")
+        if ts is not None:
+            t_min, t_max = min(t_min, ts), max(t_max, ts)
+        if e["ph"] == "B":
+            open_ev.setdefault(e["pid"], []).append((e["name"], ts))
+        elif e["ph"] == "E":
+            stack = open_ev.get(e["pid"])
+            if stack:
+                name, ts0 = stack.pop()
+                totals[name][0] += ts - ts0
+                totals[name][1] += 1
+    host = {k: v for k, v in totals.items()
+            if k in ("H2D", "CKPT_SNAPSHOT", "CKPT_WRITE")}
+    if not host:
+        raise SystemExit(
+            f"profile_step: no host-plane phases (H2D/CKPT_*) in {path} — "
+            "run training with HOROVOD_TIMELINE set, prefetch enabled "
+            "(Trainer(prefetch>=1) passes the world sharding through) "
+            "and/or an AsyncCheckpointer attached")
+    span_ms = (t_max - t_min) / 1e3
+    print(f"host-plane phases ({path}; trace span {span_ms:.1f} ms):")
+    print(f"{'phase':<16}{'total ms':>10}{'n':>6}{'mean ms':>10}"
+          f"{'% span':>8}")
+    for name, (us, n) in sorted(host.items(), key=lambda kv: -kv[1][0]):
+        ms = us / 1e3
+        print(f"{name:<16}{ms:>10.2f}{n:>6}{ms / n:>10.2f}"
+              f"{100 * ms / span_ms if span_ms else 0:>8.1f}")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50")
@@ -162,7 +217,15 @@ def main():
                    choices=["xla", "fused"])
     p.add_argument("--steps", type=int, default=None,
                    help="steps per dispatch (default: the bench config)")
+    p.add_argument("--timeline", default=None, metavar="FILE",
+                   help="summarize host-plane phases (H2D, CKPT_*) from a "
+                        "HOROVOD_TIMELINE trace instead of profiling — "
+                        "works on any host, no TPU needed")
     args = p.parse_args()
+
+    if args.timeline:
+        timeline_host_report(args.timeline)
+        return
 
     import bench
 

@@ -129,4 +129,20 @@ HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
 HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 python bench.py --scaling
 
+echo "== perf smoke: gradient accumulation end-to-end (docs/performance.md) =="
+# The accumulated step must complete and report nonzero throughput, and the
+# JSON line must carry the accum_steps knob so BENCH_*.json artifacts are
+# attributable. (--model pins the conv line only; smoke mode swaps in the
+# steps-capped cifar20 config.)
+HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python bench.py --model resnet50 --accum-steps 2 | tee /tmp/bench_accum.json
+python - <<'EOF'
+import json
+line = json.loads(open("/tmp/bench_accum.json").read().strip().splitlines()[-1])
+assert line["value"] > 0, f"zero throughput: {line}"
+assert line["accum_steps"] == 2, f"accum_steps knob not recorded: {line}"
+print(f"accum smoke OK: {line['value']} {line['unit']} @ accum_steps=2")
+EOF
+
 echo "CI OK"

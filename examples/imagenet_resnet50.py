@@ -37,6 +37,9 @@ def main():
     p.add_argument("--compression", choices=["none", "bf16"], default="none",
                    help="gradient compression for the allreduce "
                         "(bf16 halves interconnect bytes at scale)")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="in-step gradient accumulation (microbatches per "
+                        "fused allreduce; docs/performance.md)")
     args = p.parse_args()
 
     hvd.init()
@@ -64,7 +67,8 @@ def main():
         jnp.zeros((2, args.image, args.image, 3)), opt,
         compression=(hvd.Compression.bf16 if args.compression == "bf16"
                      else hvd.Compression.none))
-    step = training.make_train_step(model, dist_opt)
+    step = training.make_train_step(model, dist_opt,
+                                    accum_steps=args.accum_steps)
     eval_step = training.make_eval_step(model)
 
     # Checkpoint-resume: rank 0 scans for the latest checkpoint and the
@@ -82,9 +86,15 @@ def main():
     tr = T.Trainer(step, state, eval_step=eval_step,
                    steps_per_epoch=steps_per_epoch, verbose=verbose)
 
+    # Async checkpointing: the epoch boundary pays only the device→host
+    # snapshot; the orbax write overlaps the next epoch's steps
+    # (docs/performance.md). The wait() below is the durability barrier.
+    ckpt_writer = T.AsyncCheckpointer()
+
     class CheckpointCallback(callbacks.Callback):
         def on_epoch_end(self, epoch, logs=None):
-            T.save_checkpoint(args.ckpt_dir, self.trainer.state)  # rank-0 only
+            T.save_checkpoint(args.ckpt_dir, self.trainer.state,
+                              writer=ckpt_writer)  # rank-0 only
 
     # Staged decay ×0.1 @ 30/60/80 (keras_imagenet_resnet50.py:118-122).
     def decay(epoch):
@@ -96,21 +106,24 @@ def main():
             return 1e-1
         return 1.0
 
-    tr.fit(
-        batches(x_train, y_train, global_batch),
-        epochs=args.epochs,
-        initial_epoch=initial_epoch,
-        callbacks=[
-            callbacks.BroadcastGlobalVariablesCallback(0),
-            callbacks.MetricAverageCallback(),
-            callbacks.LearningRateWarmupCallback(
-                warmup_epochs=args.warmup_epochs,
-                steps_per_epoch=steps_per_epoch, verbose=int(verbose)),
-            callbacks.LearningRateScheduleCallback(
-                decay, start_epoch=args.warmup_epochs),
-            CheckpointCallback(),
-        ],
-    )
+    try:
+        tr.fit(
+            batches(x_train, y_train, global_batch),
+            epochs=args.epochs,
+            initial_epoch=initial_epoch,
+            callbacks=[
+                callbacks.BroadcastGlobalVariablesCallback(0),
+                callbacks.MetricAverageCallback(),
+                callbacks.LearningRateWarmupCallback(
+                    warmup_epochs=args.warmup_epochs,
+                    steps_per_epoch=steps_per_epoch, verbose=int(verbose)),
+                callbacks.LearningRateScheduleCallback(
+                    decay, start_epoch=args.warmup_epochs),
+                CheckpointCallback(),
+            ],
+        )
+    finally:
+        ckpt_writer.close()  # every epoch checkpoint durable before eval/exit
 
     # Allreduced final eval (keras_imagenet_resnet50.py:150).
     ev = eval_step(tr.state, training.shard_batch(

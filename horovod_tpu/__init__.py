@@ -70,6 +70,7 @@ from . import models  # noqa: F401
 from . import serve  # noqa: F401
 from . import training  # noqa: F401
 from .trainer import (  # noqa: F401
+    AsyncCheckpointer,
     Trainer,
     save_checkpoint,
     restore_checkpoint,

@@ -25,6 +25,7 @@ Typical loop::
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
@@ -122,22 +123,73 @@ class _Sentinel:
 _END = _Sentinel()
 
 
-def prefetch_to_device(batches: Iterable, size: int = 2) -> Iterator:
+def prefetch_to_device(batches: Iterable, size: int = 2,
+                       sharding: Optional[Any] = None,
+                       timeline: Optional[Any] = None) -> Iterator:
     """Iterate ``batches`` with a background thread staying ``size`` batches
     ahead. Exceptions in the source iterator re-raise at the consuming
     ``next()`` call. Abandoning the iterator early (a ``break``, a
     stop-at-step hook) stops the worker, releases its staged batches, and
     closes the source iterator — no thread or device memory outlives the
     consumer.
+
+    ``sharding`` places each batch from the WORKER thread: pass a single
+    ``NamedSharding`` (applied to every leaf — e.g. the world mesh's
+    leading-axis split) or a pytree of shardings matching the batch. This
+    is what makes the prefetch depth actually overlap H2D for sharded
+    meshes — without it the source must yield already-placed batches, and
+    a source built on a default single-device ``device_put`` serializes
+    the transfer into the consuming ``next()``. Each placement is recorded
+    as an ``H2D`` timeline phase (``timeline`` defaults to the runtime's
+    writer) so a trace can attribute input-bound vs compute-bound steps.
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
-    return _prefetch_gen(batches, size)
+    return _prefetch_gen(batches, size, sharding, timeline)
 
 
-def _prefetch_gen(batches: Iterable, size: int) -> Iterator:
+# Timeline-row pool: concurrent streams (train + eval) need DISTINCT rows
+# so B/E events don't interleave, but sequential streams (one per epoch)
+# reuse freed ids — otherwise a long run grows one single-use Chrome-trace
+# pseudo-process (and Timeline dict entry) per epoch without bound.
+_h2d_rows = itertools.count()
+_h2d_free: list = []
+_h2d_lock = threading.Lock()
+
+
+def _prefetch_gen(batches: Iterable, size: int,
+                  sharding: Optional[Any] = None,
+                  timeline: Optional[Any] = None) -> Iterator:
+    import jax
+
+    from . import runtime
+    from .utils import timeline as _tl
+
     q: "queue.Queue" = queue.Queue(maxsize=size)
     stop = threading.Event()
+
+    if timeline is None and runtime.is_initialized():
+        timeline = runtime.world().timeline
+    with _h2d_lock:
+        row_id = _h2d_free.pop() if _h2d_free else next(_h2d_rows)
+    row = f"input.h2d.{row_id}"
+
+    def _place(b):
+        if sharding is None:
+            return b
+        with _tl.maybe_op(timeline, row, _tl.H2D):
+            if isinstance(sharding, jax.sharding.Sharding):
+                placed = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sharding), b)
+            else:
+                placed = jax.device_put(b, sharding)
+            # Block HERE, on the worker thread: device_put only dispatches
+            # the copy, so without this the H2D phase measures dispatch
+            # (~0) and the input-bound attribution under-reports — and a
+            # dequeued batch must already be device-resident for the
+            # prefetch depth to mean completed transfers.
+            jax.block_until_ready(placed)
+        return placed
 
     def _put(item) -> bool:
         # Bounded put with a stop check: the consumer may vanish while the
@@ -153,7 +205,7 @@ def _prefetch_gen(batches: Iterable, size: int) -> Iterator:
     def _fill():
         try:
             for b in batches:
-                if not _put(b):
+                if not _put(_place(b)):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised at consumer
             _put(e)
@@ -180,6 +232,11 @@ def _prefetch_gen(batches: Iterable, size: int) -> Iterator:
             except queue.Empty:
                 break
         t.join(timeout=5)
+        if not t.is_alive():
+            # Recycle the timeline row only once the worker can no longer
+            # emit on it (a wedged worker leaks its id — safe, just wider).
+            with _h2d_lock:
+                _h2d_free.append(row_id)
         close = getattr(batches, "close", None)
         if close is not None:
             close()

@@ -76,7 +76,7 @@ class ElasticState:
 
     def __init__(self, params: Any, opt_state: Any = None, step: int = 0,
                  *, directory: Optional[str] = None, commit_every: int = 1,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, writer: Any = None):
         self.params = params
         self.opt_state = opt_state
         self.step = int(step)
@@ -84,6 +84,11 @@ class ElasticState:
             directory or os.environ.get("HVD_ELASTIC_DIR") or ".hvd_elastic")
         self.commit_every = max(1, int(commit_every))
         self.max_to_keep = max_to_keep
+        # Optional horovod_tpu.trainer.AsyncCheckpointer: commits snapshot
+        # device→host here and serialize on the writer thread, keeping the
+        # two-phase contract — the marker is written by the writer's
+        # on_durable hook, strictly after the checkpoint bytes are down.
+        self.writer = writer
 
     # -- layout ------------------------------------------------------------
     def _dir(self) -> str:
@@ -106,14 +111,49 @@ class ElasticState:
         return os.path.join(self._dir(), f"ckpt_{int(step)}.committed")
 
     def commit(self) -> str:
-        """Durably commit the current (params, opt_state) at ``step``."""
+        """Commit the current (params, opt_state) at ``step``.
+
+        Synchronous by default (durable on return). With a ``writer``, the
+        device→host snapshot happens here and the orbax write + marker +
+        retention run on the writer thread — durable after
+        ``self.wait()`` — with the write→marker ordering preserved because
+        the marker hangs off the writer's on-durable hook."""
         from .parallel import checkpoint as _ckpt
+        step = self.step
+        if self.writer is None:
+            path = _ckpt.save_sharded(self._dir(), step, self.params,
+                                      self.opt_state,
+                                      max_to_keep=self.max_to_keep)
+            self._mark_durable(step, path)
+            return path
+        if (runtime.is_initialized() and runtime.process_count() > 1
+                and not runtime.world().env_world):
+            # jax.distributed world: params may span non-addressable
+            # devices (device_get would raise) and the orbax write is a
+            # COLLECTIVE all processes must join — a per-process background
+            # thread cannot honor either. Fail with the remedy instead of
+            # crashing on the first sharded leaf.
+            raise ValueError(
+                "ElasticState(writer=...) is supported on single-controller "
+                "and tpurun env-world runs only; on a jax.distributed "
+                "multi-process world the sharded checkpoint write is a "
+                "collective — drop the writer to commit synchronously")
+        host_params, host_opt = _ckpt.snapshot_to_host(
+            (self.params, self.opt_state), timeline=self.writer.timeline)
+        path = _ckpt._ckpt_path(self._dir(), step)
+        self.writer.submit(
+            lambda: _ckpt.save_sharded(self._dir(), step, host_params,
+                                       host_opt,
+                                       max_to_keep=self.max_to_keep),
+            on_durable=lambda: self._mark_durable(step, path))
+        return path
+
+    def _mark_durable(self, step: int, path: str) -> None:
+        """Phase 2 of the commit: marker + retention, only ever called
+        after the checkpoint bytes for ``step`` are fully written."""
         from .trainer import apply_retention
-        path = _ckpt.save_sharded(self._dir(), self.step, self.params,
-                                  self.opt_state,
-                                  max_to_keep=self.max_to_keep)
-        with open(self._marker(self.step), "w") as f:
-            f.write(str(self.step))
+        with open(self._marker(step), "w") as f:
+            f.write(str(step))
             f.flush()
             os.fsync(f.fileno())
         if (runtime.is_initialized() and runtime.world().env_world
@@ -131,7 +171,13 @@ class ElasticState:
                     os.unlink(self._marker(s))
                 except OSError:
                     pass
-        return path
+
+    def wait(self) -> None:
+        """Barrier for async commits: returns once every enqueued commit is
+        durable (checkpoint bytes AND marker), re-raising writer errors.
+        No-op without a writer."""
+        if self.writer is not None:
+            self.writer.wait()
 
     def _marked_steps(self):
         base = self._dir()
@@ -170,6 +216,7 @@ class ElasticState:
         two-phase commit finished (marker present) count — a torn write
         from a rank killed mid-checkpoint is invisible here.
         """
+        self.wait()  # async commits in flight count once durable, not before
         mine = self._local_latest()
         if runtime.is_initialized() and runtime.process_count() > 1:
             from .ops.collectives import allgather_object
@@ -183,6 +230,7 @@ class ElasticState:
         """Restore params/opt_state/step from the last common commit (or
         an explicit ``step``) onto the current trees' shardings."""
         from .parallel import checkpoint as _ckpt
+        self.wait()
         if step is None:
             step = self.latest_committed()
         if step is None:

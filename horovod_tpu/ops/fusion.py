@@ -87,15 +87,34 @@ def _unfuse(flat: jax.Array, leaves: Sequence[jax.Array]) -> List[jax.Array]:
     return out
 
 
+def _prescale_array(x, prescale):
+    """Scale one flat/bucketed array before its collective. Dtype-preserving
+    (the scale is cast to the operand dtype, so bf16 buckets stay bf16 on
+    the wire); integer leaves pass through untouched — a fractional scale
+    would silently floor them."""
+    if prescale is None or not jnp.issubdtype(x.dtype, jnp.inexact):
+        return x
+    return x * jnp.asarray(prescale, x.dtype)
+
+
 def fused_allreduce(tree, average: bool = True,
                     fusion_threshold: Optional[int] = None,
-                    axis_name: str = AXIS):
+                    axis_name: str = AXIS,
+                    prescale: Optional[float] = None):
     """Allreduce a pytree with fusion bucketing. Compiled-context only
     (it is the gradient hot path inside the jitted train step).
 
     Sparse (:class:`~horovod_tpu.ops.sparse.IndexedSlices`) leaves are kept
     whole and routed through the two-allgather sparse path — never flattened
-    into dense buckets (their integer indices must not be summed)."""
+    into dense buckets (their integer indices must not be summed).
+
+    ``prescale`` multiplies every bucket by a scalar *before* the reduce —
+    one fused multiply on the already-flattened bucket, not one per leaf —
+    which is how gradient accumulation folds its ``1/accum_steps`` into the
+    same traversal (the reference's ``backward_passes_per_step`` divides by
+    the global microbatch count at the same point). The reduce is linear, so
+    pre- and post-scaling are equivalent; prescaling keeps the bucketed tree
+    the single thing the collective ever sees."""
     from .sparse import IndexedSlices, allreduce_indexed_slices
 
     leaves, treedef = jax.tree_util.tree_flatten(
@@ -108,18 +127,24 @@ def fused_allreduce(tree, average: bool = True,
     dense_idx = [i for i, l in enumerate(leaves)
                  if not isinstance(l, IndexedSlices)]
     for i in (i for i in range(len(leaves)) if i not in dense_idx):
+        s = leaves[i]
+        if prescale is not None:
+            s = IndexedSlices(_prescale_array(s.values, prescale),
+                              s.indices, s.dense_shape)
         reduced[i] = allreduce_indexed_slices(
-            leaves[i], average=average, axis_name=axis_name)
+            s, average=average, axis_name=axis_name)
 
     dense = [leaves[i] for i in dense_idx]
     buckets = plan_buckets(dense, fusion_threshold)
     for bucket in buckets:
         if len(bucket) == 1:
             j = bucket[0]
-            reduced[dense_idx[j]] = _reduce_in_trace(dense[j], op, axis_name)
+            reduced[dense_idx[j]] = _reduce_in_trace(
+                _prescale_array(dense[j], prescale), op, axis_name)
         else:
             members = [dense[j] for j in bucket]
-            flat = _reduce_in_trace(_fuse(members), op, axis_name)
+            flat = _reduce_in_trace(
+                _prescale_array(_fuse(members), prescale), op, axis_name)
             for j, r in zip(bucket, _unfuse(flat, members)):
                 reduced[dense_idx[j]] = r
     return jax.tree_util.tree_unflatten(treedef, reduced)

@@ -90,6 +90,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          fusion_threshold: Optional[int] = None,
                          sparse_as_dense: bool = False,
                          compression: Any = Compression.none,
+                         accum_steps: int = 1,
                          axis_name: str = AXIS
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with fused gradient allreduce.
@@ -100,7 +101,21 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     jitted train step under ``shard_map`` over the world mesh.
     ``compression=Compression.bf16`` halves allreduce bytes (see
     :class:`Compression`).
+
+    ``accum_steps`` is the reference's ``backward_passes_per_step``: the
+    caller feeds ``update`` the *sum* of N per-microbatch gradients and one
+    fused allreduce fires per accumulated step, averaged by the **global
+    microbatch count** (``accum_steps × size``) — the ``1/accum_steps`` is
+    folded into the fused bucket traversal (:func:`fused_allreduce`'s
+    ``prescale``) and ``average=True`` supplies the ``1/size``. Drive your
+    own accumulation loop with this knob, or use
+    ``make_train_step(accum_steps=N)`` which scans microbatches inside the
+    compiled step and performs the microbatch mean itself (do NOT set both:
+    the gradients would be divided by N twice).
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
     def init_fn(params):
         return optimizer.init(params)
 
@@ -108,9 +123,12 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         grads = allreduce_gradients(
             grads, average=average, fusion_threshold=fusion_threshold,
             sparse_as_dense=sparse_as_dense, compression=compression,
-            axis_name=axis_name)
+            accum_steps=accum_steps, axis_name=axis_name)
         return optimizer.update(grads, state, params, **extra)
 
+    # Stamp the knob where make_train_step can see it: setting accum_steps
+    # on BOTH layers would silently divide gradients by N twice.
+    update_fn.accum_steps = accum_steps
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -119,12 +137,29 @@ def allreduce_gradients(grads,
                         fusion_threshold: Optional[int] = None,
                         sparse_as_dense: bool = False,
                         compression: Any = Compression.none,
+                        accum_steps: int = 1,
                         axis_name: str = AXIS):
     """Allreduce a gradient pytree: dense leaves via fused flat buckets,
-    sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``)."""
+    sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``).
+    ``accum_steps > 1`` divides by the local microbatch count (the caller
+    passes a gradient *sum* over N backward passes) as a prescale fused
+    into the bucket traversal."""
+    prescale = None if accum_steps <= 1 else 1.0 / accum_steps
     if runtime.is_initialized() and runtime.size() == 1 \
             and not runtime._in_world_trace():
-        return grads  # size()==1 fast path (__init__.py:180-182)
+        # size()==1 fast path (__init__.py:180-182) — but the microbatch
+        # mean is not a cross-rank concern and must still happen.
+        if prescale is None:
+            return grads
+        from .ops.fusion import _prescale_array
+
+        def _scale(l):
+            if _is_sparse_leaf(l):
+                return IndexedSlices(_prescale_array(l.values, prescale),
+                                     l.indices, l.dense_shape)
+            return _prescale_array(l, prescale)
+        return jax.tree_util.tree_map(_scale, grads,
+                                      is_leaf=_is_sparse_leaf)
 
     if sparse_as_dense:
         grads = jax.tree_util.tree_map(
@@ -151,7 +186,7 @@ def allreduce_gradients(grads,
     # through the two-allgather sparse path.
     reduced = fused_allreduce(compressed, average=average,
                               fusion_threshold=fusion_threshold,
-                              axis_name=axis_name)
+                              axis_name=axis_name, prescale=prescale)
     return jax.tree_util.tree_map(
         lambda l, c: l if _is_sparse_leaf(l)
         else compression.decompress(l, c.dtype),

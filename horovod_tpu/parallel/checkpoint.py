@@ -34,6 +34,22 @@ def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"ckpt_{step}")
 
 
+def snapshot_to_host(tree: Any, timeline: Any = None) -> Any:
+    """The snapshot half of an async checkpoint (``CKPT_SNAPSHOT`` timeline
+    phase): one bulk device→host fetch of a pytree into numpy.
+
+    This is the ONLY part of a save that needs the live device state — the
+    returned host copy is immutable, so the training loop may donate or
+    overwrite the device buffers while a background writer (e.g.
+    :class:`horovod_tpu.trainer.AsyncCheckpointer`) serializes. A single
+    ``jax.device_get`` over the whole tree batches the D2H transfers
+    instead of syncing leaf-by-leaf.
+    """
+    from ..utils import timeline as _tl
+    with _tl.maybe_op(timeline, "ckpt.snapshot", _tl.CKPT_SNAPSHOT):
+        return jax.device_get(tree)
+
+
 def save_sharded(directory: str, step: int, params: Any,
                  opt_state: Any, max_to_keep: Optional[int] = None) -> str:
     """Write the sharded (params, opt_state) trees at ``step``.

@@ -11,16 +11,22 @@ checkpointing (SURVEY §5.4) via orbax.
 
 from __future__ import annotations
 
+import atexit
 import os
+import queue
+import sys
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import runtime
 from .testing import faults as _faults
-from .training import TrainState, shard_batch
+from .training import TrainState, make_batch_placer, shard_batch
+from .utils import timeline as _timeline
 
 
 class Trainer:
@@ -47,12 +53,46 @@ class Trainer:
         # Global step counter across epochs — drives the deterministic
         # fault-injection hook (testing/faults.py; no-op in production).
         self._global_step = 0
+        # Device-resident running-metric reducer (built lazily): epoch logs
+        # come from one (sums, count) accumulator updated per step, not an
+        # O(steps) host list of device arrays fetched in a storm at epoch
+        # end. The add is a tiny jitted program so the step loop never
+        # synchronizes on a metric value.
+        self._metric_add = None
+        self._eval_placer: Optional[Callable] = None
 
     def _stream(self, data: Iterable):
         from .data import prefetch_to_device, shard_iterator
         if self.prefetch and self.prefetch > 0:
+            if runtime.is_initialized() and not runtime.world().env_world:
+                # Hand the prefetch thread the world sharding so the
+                # host→device copy of batch k+1 overlaps step k on the
+                # device, instead of happening synchronously at next().
+                return prefetch_to_device(
+                    iter(data), self.prefetch,
+                    sharding=runtime.ranked_sharding())
             return prefetch_to_device(shard_iterator(data), self.prefetch)
         return shard_iterator(data)
+
+    # -- running metrics (device-resident, fetched once per epoch) ---------
+
+    def _accumulate_metrics(self, sums, metrics):
+        if sums is None:
+            for k in metrics:
+                for leaf in jax.tree_util.tree_leaves(metrics[k]):
+                    if np.ndim(leaf) != 0:
+                        raise ValueError(
+                            f"train-step metric {k!r} has shape "
+                            f"{np.shape(leaf)}; metrics_fn must return "
+                            f"scalar leaves (reduce to a per-batch mean "
+                            f"before returning) — a non-scalar here would "
+                            f"silently broadcast into the epoch mean")
+            sums = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((), jnp.float32), metrics)
+        if self._metric_add is None:
+            self._metric_add = jax.jit(lambda acc, m: jax.tree_util.tree_map(
+                lambda a, x: a + jnp.asarray(x, jnp.float32), acc, m))
+        return self._metric_add(sums, metrics)
 
     def fit(self, data: Callable[[], Iterable], epochs: int = 1,
             callbacks: Optional[List] = None,
@@ -81,7 +121,7 @@ class Trainer:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             nsteps = 0
-            epoch_metrics: List[Dict[str, Any]] = []
+            metric_sums = None
             stream = self._stream(data())
             try:
                 for batch_idx, batch in enumerate(stream):
@@ -91,7 +131,8 @@ class Trainer:
                     for cb in callbacks:
                         cb.on_batch_begin(batch_idx)
                     self.state, metrics = self.train_step(self.state, batch)
-                    epoch_metrics.append(metrics)
+                    metric_sums = self._accumulate_metrics(metric_sums,
+                                                           metrics)
                     for cb in callbacks:
                         cb.on_batch_end(batch_idx)
                     nsteps += 1
@@ -107,19 +148,25 @@ class Trainer:
             # Epoch logs are the running mean over the epoch's batches (the
             # Keras fit semantics the reference callbacks assume), not the
             # last batch — ReduceLROnPlateau/MetricAverage need a stable
-            # signal, not one noisy step.
+            # signal, not one noisy step. One device fetch for the whole
+            # epoch: the (sums, count) accumulator replaces the former
+            # per-step list whose epoch-end np.mean forced a sync per
+            # retained step.
             logs: Dict[str, float] = {}
-            if epoch_metrics:
-                for k in epoch_metrics[0]:
-                    logs[k] = float(np.mean(
-                        [np.asarray(m[k]) for m in epoch_metrics]))
+            if metric_sums is not None:
+                for k, v in jax.device_get(metric_sums).items():
+                    logs[k] = float(v) / nsteps
             if eval_data is not None and self.eval_step is not None:
+                if self._eval_placer is None:
+                    # Hoisted: mesh lookup + NamedSharding construction
+                    # happen once, not per eval batch per epoch.
+                    self._eval_placer = make_batch_placer()
                 evals = []
                 for b in eval_data():
                     rows = int(np.shape(
                         jax.tree_util.tree_leaves(b)[0])[0])
-                    evals.append((rows, self.eval_step(self.state,
-                                                       shard_batch(b))))
+                    evals.append((rows, self.eval_step(
+                        self.state, self._eval_placer(b))))
                 if evals:  # the eval iterable can be empty at large world sizes
                     total = sum(r for r, _ in evals)
                     for k in evals[0][1]:
@@ -142,9 +189,136 @@ class Trainer:
 # Checkpoint / resume — rank-0-only write + broadcast-on-restore (SURVEY §5.4).
 # ---------------------------------------------------------------------------
 
+class AsyncCheckpointer:
+    """Background checkpoint writer: the step loop pays only the
+    device→host snapshot; serialization happens off the critical path.
+
+    The synchronous ``save_checkpoint`` stalls the TPU for the whole orbax
+    write (seconds at real model sizes, every epoch). The async protocol
+    splits the save at the only point that needs the live state:
+
+    1. **snapshot** (caller thread, ``CKPT_SNAPSHOT`` timeline phase) —
+       ``jax.device_get`` the state into host numpy. The training loop can
+       mutate/donate device state freely afterwards.
+    2. **write** (this writer's thread, ``CKPT_WRITE`` phase) — orbax
+       serialization + retention GC of the immutable host copy.
+    3. **durable hook** — ``on_durable`` runs only after the write
+       succeeded; the elastic two-phase commit hangs its marker file here,
+       so a crash mid-write can never leave a marker pointing at torn
+       bytes (the PR-1 contract, :mod:`horovod_tpu.elastic`).
+
+    ``wait()`` blocks until every submitted write is durable and re-raises
+    the first writer error; ``close()`` waits, stops the thread, and makes
+    further submits fail. ``max_pending`` bounds host memory: the queue
+    holds at most that many snapshots before ``submit`` backpressures.
+
+    The writer thread is a daemon (a wedged orbax write must never hang
+    interpreter exit), so an exit without ``close()`` — including an
+    exception unwinding past the training loop — would silently drop
+    queued writes; an ``atexit`` hook drains them best-effort (bounded
+    wait, errors logged not raised). Prefer an explicit ``close()`` /
+    ``with`` block: only those re-raise writer failures.
+    """
+
+    def __init__(self, max_pending: int = 2,
+                 timeline: Optional[Any] = None):
+        if timeline is None and runtime.is_initialized():
+            timeline = runtime.world().timeline
+        self.timeline = timeline
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, max_pending))
+        self._errors: List[BaseException] = []
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-ckpt-writer", daemon=True)
+        self._thread.start()
+        atexit.register(self._drain_at_exit)
+
+    def submit(self, write_fn: Callable[[], Any],
+               on_durable: Optional[Callable[[], Any]] = None) -> None:
+        """Enqueue a write job (host data must already be snapshotted).
+        Blocks only when ``max_pending`` writes are already in flight."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._q.put((write_fn, on_durable))
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                write_fn, on_durable = item
+                try:
+                    with _timeline.maybe_op(self.timeline, "ckpt.write",
+                                            _timeline.CKPT_WRITE):
+                        write_fn()
+                    if on_durable is not None:
+                        on_durable()
+                except BaseException as e:  # noqa: BLE001 — to wait()
+                    self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def wait(self) -> None:
+        """Barrier: returns once every submitted write is durable on disk,
+        re-raising the first writer failure. Call before any restore (or
+        before trusting the directory contents) — async means the bytes
+        land later, not that they may not land."""
+        self._q.join()
+        if self._errors:
+            raise self._errors.pop(0)
+
+    def close(self) -> None:
+        """Drain pending writes, stop the thread, surface any error.
+
+        Like ``wait()`` this is a durability barrier: it blocks until every
+        pending write lands, HOWEVER long that takes — a wedged write (dead
+        NFS) holds ``close()`` rather than returning with bytes not
+        durable. The bounded-exit protection lives one layer down: the
+        daemon thread plus the atexit drain keep an *unclosed* writer from
+        hanging interpreter shutdown."""
+        atexit.unregister(self._drain_at_exit)
+        if self._closed:
+            self._thread.join(timeout=60)
+            if self._errors:
+                raise self._errors.pop(0)
+            return
+        self._closed = True
+        self._q.put(None)
+        self._q.join()
+        self._thread.join(timeout=60)
+        if self._errors:
+            raise self._errors.pop(0)
+
+    def _drain_at_exit(self) -> None:
+        """Bounded best-effort drain at interpreter shutdown: the queue's
+        pending writes run before the stop sentinel, and the join timeout
+        keeps a wedged write from hanging exit (the reason the thread is
+        a daemon in the first place)."""
+        if self._closed or not self._thread.is_alive():
+            return
+        self._closed = True
+        try:
+            self._q.put(None, timeout=60)
+        except queue.Full:
+            return
+        self._thread.join(timeout=60)
+        for e in self._errors:
+            print(f"[hvd-ckpt-writer] checkpoint write failed at exit: {e!r}",
+                  file=sys.stderr)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def save_checkpoint(directory: str, state: TrainState,
                     step: Optional[int] = None,
-                    max_to_keep: Optional[int] = None) -> Optional[str]:
+                    max_to_keep: Optional[int] = None,
+                    writer: Optional[AsyncCheckpointer] = None
+                    ) -> Optional[str]:
     """Write a checkpoint — rank 0 only, like the reference
     (``checkpoint_dir=None`` on other ranks, ``README.md:78-80``).
     Returns the path written, or None on non-root ranks.
@@ -152,15 +326,34 @@ def save_checkpoint(directory: str, state: TrainState,
     ``max_to_keep``: after a successful write, delete the oldest
     checkpoints beyond the newest ``max_to_keep`` (retention is the
     writer's job since only rank 0 touches the directory).
+
+    With ``writer`` (an :class:`AsyncCheckpointer`), only the device→host
+    snapshot happens here; the orbax write and retention GC run on the
+    writer's thread while training continues. The returned path is durable
+    only after ``writer.wait()``.
     """
     if runtime.is_initialized() and runtime.world().controller_rank != 0:
         return None
     import orbax.checkpoint as ocp
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, jax.tree_util.tree_map(np.asarray, state), force=True)
-    apply_retention(directory, path, max_to_keep)
+    from .parallel.checkpoint import snapshot_to_host
+    tl = writer.timeline if writer is not None else (
+        runtime.world().timeline if runtime.is_initialized() else None)
+    host = snapshot_to_host(state, timeline=tl)
+
+    def _write():
+        # orbax writes into a tmp dir and renames on finalize, so a writer
+        # killed mid-write never leaves a visible ckpt_<step> for the
+        # latest-step restore scan to trust.
+        ocp.PyTreeCheckpointer().save(path, host, force=True)
+        apply_retention(directory, path, max_to_keep)
+
+    if writer is None:
+        with _timeline.maybe_op(tl, "ckpt.write", _timeline.CKPT_WRITE):
+            _write()
+    else:
+        writer.submit(_write)
     return path
 
 

@@ -62,6 +62,168 @@ def accuracy(logits, labels):
                     .astype(jnp.float32))
 
 
+# ---------------------------------------------------------------------------
+# In-step gradient accumulation — the TPU-native ``backward_passes_per_step``
+# (Sergeev & Del Balso 2018 §4; GPipe microbatching, Huang et al. 2019).
+# The scan lives INSIDE the compiled SPMD program: gradients for N
+# microbatches are summed on-device and the fused psum fires once per
+# accumulated step, so interconnect traffic per sample drops by N and the
+# per-chip batch can exceed HBM limits via the optional remat policy.
+# ---------------------------------------------------------------------------
+
+def _acc_dtype(dtype):
+    """Accumulator dtype: fp32 for sub-fp32 floats (bf16 microbatch grads
+    summed in bf16 lose ~3 bits over 4 microbatches), unchanged otherwise."""
+    if jnp.issubdtype(dtype, jnp.floating) \
+            and jnp.dtype(dtype).itemsize < 4:
+        return jnp.float32
+    return jnp.dtype(dtype)
+
+
+def _split_microbatches(tree, n: int):
+    """Reshape every leaf ``(B, ...) -> (n, B // n, ...)`` (leading-axis
+    contiguous split; the mean over equal microbatches equals the full-batch
+    mean regardless of row order)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.reshape(x, (n, x.shape[0] // n) + x.shape[1:]), tree)
+
+
+def _default_accum_unroll(accum_steps: int) -> int:
+    """Scan unroll for the microbatch loop. On TPU the rolled ``while`` is
+    right (compile time stays O(1) in N; XLA pipelines the body). XLA:CPU
+    executes while-loop bodies WITHOUT intra-op parallelism — measured 8×
+    slower per microbatch on the bench host — so off-TPU the loop is fully
+    unrolled, trading compile time for the multi-core step."""
+    return 1 if jax.default_backend() == "tpu" else accum_steps
+
+
+def _accumulate_grads(vag: Callable, params, batch_stats, inputs, labels,
+                      rng_for: Callable, accum_steps: int,
+                      metrics_fn: Optional[Callable],
+                      unroll: Optional[int] = None):
+    """Scan ``accum_steps`` microbatches, summing gradients on-device.
+
+    ``vag`` is ``jax.value_and_grad(loss, has_aux=True)`` with signature
+    ``(params, batch_stats, inputs, labels, rng) -> ((loss, (logits,
+    new_stats)), grads)``; ``rng_for(i)`` derives the i-th microbatch's
+    dropout key. Returns ``(mean_loss, new_batch_stats, mean_grads,
+    mean_extras)`` where the means are over microbatches — composed with the
+    ``average=True`` world pmean downstream, gradients end up divided by the
+    global microbatch count (``accum_steps × size``), exactly the full-batch
+    scaling. Integer metric leaves (e.g. counts) keep the microbatch sum —
+    the full-batch value — instead of a flooring integer mean.
+    Gradients accumulate in fp32 when their dtype is narrower and
+    are cast back after the mean; batch statistics thread sequentially
+    through the microbatches (N momentum updates per step — the defined
+    semantics for BN under accumulation, not bit-equal to one full-batch
+    update).
+    """
+    n = accum_steps
+    mb_in = _split_microbatches(inputs, n)
+    mb_lab = _split_microbatches(labels, n)
+    first = (jax.tree_util.tree_map(lambda x: x[0], mb_in),
+             jax.tree_util.tree_map(lambda x: x[0], mb_lab))
+
+    # Structure probe (no FLOPs): shapes/dtypes of grads, logits and metric
+    # extras, to build type-stable zero carries for the scan.
+    (_, (logits_s, _)), grads_s = jax.eval_shape(
+        vag, params, batch_stats, first[0], first[1], rng_for(0))
+    extras_s = (jax.eval_shape(metrics_fn, logits_s, first[1])
+                if metrics_fn is not None else None)
+
+    def _zeros(s):
+        return jnp.zeros(s.shape, _acc_dtype(s.dtype))
+
+    carry = (
+        jax.tree_util.tree_map(_zeros, grads_s),
+        batch_stats,
+        jnp.zeros((), jnp.float32),
+        (jax.tree_util.tree_map(_zeros, extras_s)
+         if metrics_fn is not None else None),
+    )
+
+    def _body(carry, xs):
+        gacc, stats, lacc, macc = carry
+        i, x, y = xs
+        (loss, (logits, new_stats)), grads = vag(
+            params, stats, x, y, rng_for(i))
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), gacc, grads)
+        lacc = lacc + loss.astype(jnp.float32)
+        if metrics_fn is not None:
+            macc = jax.tree_util.tree_map(
+                lambda a, m: a + jnp.asarray(m).astype(a.dtype),
+                macc, metrics_fn(logits, y))
+        return (gacc,
+                new_stats if new_stats is not None else stats,
+                lacc, macc), None
+
+    (gacc, stats, lacc, macc), _ = jax.lax.scan(
+        _body, carry, (jnp.arange(n), mb_in, mb_lab),
+        unroll=_default_accum_unroll(n) if unroll is None else unroll)
+
+    inv = 1.0 / n
+    grads = jax.tree_util.tree_map(
+        lambda a, s: (a * jnp.asarray(inv, a.dtype)).astype(s.dtype),
+        gacc, grads_s)
+
+    def _mean_extra(a, s):
+        # Integer metric leaves keep the microbatch SUM: jnp.asarray(1/n,
+        # int_dtype) is 0 (same guard as fusion._prescale_array), and for a
+        # count-style metric the sum over microbatches IS the full-batch
+        # value the accum_steps=1 path reports.
+        if not jnp.issubdtype(s.dtype, jnp.inexact):
+            return a.astype(s.dtype)
+        return (a * jnp.asarray(inv, a.dtype)).astype(s.dtype)
+
+    extras = None
+    if metrics_fn is not None:
+        extras = jax.tree_util.tree_map(_mean_extra, macc, extras_s)
+    return lacc * inv, stats, grads, extras
+
+
+def _check_accum_batch(inputs, accum_steps: int, shards: int) -> None:
+    """Leading-dim divisibility check for the accumulated step — raised
+    eagerly with the full arithmetic instead of a reshape error from deep
+    inside the trace."""
+    leaves = jax.tree_util.tree_leaves(inputs)
+    if not leaves:
+        return
+    rows = leaves[0].shape[0]
+    if rows % (shards * accum_steps):
+        raise ValueError(
+            f"global batch of {rows} rows cannot be split into "
+            f"{shards} shard(s) x {accum_steps} microbatches "
+            f"(needs divisibility by {shards * accum_steps}); adjust the "
+            f"batch size or accum_steps")
+
+
+def _build_value_and_grad(model, loss_fn, remat):
+    """Shared loss/grad builder for BOTH execution planes (the compiled
+    SPMD step and the env-world grads half): variables-dict assembly,
+    mutable batch_stats, dropout rng plumbing, optional remat wrap. One
+    definition so a change to loss semantics cannot silently diverge the
+    two planes."""
+
+    def _loss(params, batch_stats, inputs, labels, step_rng):
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+        out = model.apply(
+            variables, inputs, train=True,
+            mutable=["batch_stats"] if batch_stats is not None else [],
+            rngs={"dropout": step_rng},
+        )
+        logits, new_vars = out if isinstance(out, tuple) else (out, {})
+        loss = loss_fn(logits, labels)
+        return loss, (logits, new_vars.get("batch_stats"))
+
+    if remat:
+        _loss = jax.checkpoint(
+            _loss, policy=None if remat is True else remat)
+    return jax.value_and_grad(_loss, has_aux=True)
+
+
 def create_train_state(model, rng, sample_input, optimizer,
                        *, average: bool = True,
                        fusion_threshold: Optional[int] = None,
@@ -101,7 +263,10 @@ def make_train_step(model,
                     mesh: Optional[jax.sharding.Mesh] = None,
                     axis_name: str = AXIS,
                     donate: bool = True,
-                    metrics_fn: Optional[Callable] = None):
+                    metrics_fn: Optional[Callable] = None,
+                    accum_steps: int = 1,
+                    accum_unroll: Optional[int] = None,
+                    remat: Any = False):
     """Build the compiled SPMD train step.
 
     The returned function has signature ``step(state, batch) -> (state,
@@ -110,21 +275,33 @@ def make_train_step(model,
     plus ``metrics_fn(logits, labels)`` extras) are already globally averaged
     via ``pmean`` — the in-step equivalent of ``MetricAverageCallback``
     (``horovod/keras/callbacks.py:37-87``).
-    """
-    mesh = mesh if mesh is not None else runtime.mesh()
 
-    def _loss(params, batch_stats, inputs, labels, step_rng):
-        variables = {"params": params}
-        if batch_stats is not None:
-            variables["batch_stats"] = batch_stats
-        out = model.apply(
-            variables, inputs, train=True,
-            mutable=["batch_stats"] if batch_stats is not None else [],
-            rngs={"dropout": step_rng},
-        )
-        logits, new_vars = out if isinstance(out, tuple) else (out, {})
-        loss = loss_fn(logits, labels)
-        return loss, (logits, new_vars.get("batch_stats"))
+    ``accum_steps=N`` is the TPU-native ``backward_passes_per_step``
+    (Sergeev & Del Balso 2018 §4): each shard's batch slice is split into N
+    microbatches scanned INSIDE the compiled program, gradients are summed
+    on-device (fp32 accumulation for sub-fp32 grads) and the fused psum
+    fires **once** per accumulated step on the microbatch-mean tree — so
+    the global batch can grow N× without growing peak activation memory or
+    interconnect traffic per step. The step owns the ``1/N`` scaling; leave
+    the ``DistributedOptimizer`` at its default ``accum_steps=1``.
+    ``accum_unroll`` overrides the microbatch-scan unroll (default: rolled
+    on TPU, fully unrolled elsewhere — see ``_default_accum_unroll``).
+
+    ``remat`` checkpoints each microbatch's forward pass (``jax.checkpoint``;
+    pass ``True`` or a ``jax.checkpoint_policies`` policy) — activations are
+    recomputed during backprop, trading ~⅓ more FLOPs for microbatch-sized
+    rather than batch-sized activation memory (GPipe, Huang et al. 2019).
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if accum_steps > 1 and getattr(dist_opt.update, "accum_steps", 1) > 1:
+        raise ValueError(
+            "accum_steps is set on BOTH make_train_step and "
+            "DistributedOptimizer — the gradients would be divided by N "
+            "twice; set it in one place (make_train_step owns the "
+            "microbatch scan and its 1/N)")
+    mesh = mesh if mesh is not None else runtime.mesh()
+    vag = _build_value_and_grad(model, loss_fn, remat)
 
     def _step(state: TrainState, inputs, labels):
         # Fresh dropout mask per step and per rank: fold the step counter
@@ -133,18 +310,25 @@ def make_train_step(model,
         step_rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(0), state.step),
             jax.lax.axis_index(axis_name))
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            _loss, has_aux=True)(state.params, state.batch_stats,
-                                 inputs, labels, step_rng)
-        # DistributedOptimizer performs the fused allreduce over `axis_name`.
+        if accum_steps == 1:
+            (loss, (logits, new_stats)), grads = vag(
+                state.params, state.batch_stats, inputs, labels, step_rng)
+            extras = (metrics_fn(logits, labels)
+                      if metrics_fn is not None else None)
+        else:
+            loss, new_stats, grads, extras = _accumulate_grads(
+                vag, state.params, state.batch_stats, inputs, labels,
+                lambda i: jax.random.fold_in(step_rng, i),
+                accum_steps, metrics_fn, unroll=accum_unroll)
+        # DistributedOptimizer performs the fused allreduce over `axis_name`
+        # — on the accumulated (microbatch-mean) tree, once per step.
         updates, new_opt_state = dist_opt.update(
             grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": jax.lax.pmean(loss, axis_name)}
-        if metrics_fn is not None:
-            extra = metrics_fn(logits, labels)
+        if extras is not None:
             metrics.update(jax.tree_util.tree_map(
-                lambda m: jax.lax.pmean(m, axis_name), extra))
+                lambda m: jax.lax.pmean(m, axis_name), extras))
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
@@ -166,16 +350,23 @@ def make_train_step(model,
 
     if _is_env_world(mesh):
         return _make_env_world_step(model, dist_opt, loss_fn, mesh,
-                                    axis_name, metrics_fn)
+                                    axis_name, metrics_fn,
+                                    accum_steps=accum_steps,
+                                    accum_unroll=accum_unroll, remat=remat)
+
+    n_shards = int(mesh.shape[axis_name]) if accum_steps > 1 else 1
 
     @functools.wraps(jitted)
     def step(state: TrainState, batch):
         inputs, labels = batch
+        if accum_steps > 1:
+            _check_accum_batch(inputs, accum_steps, n_shards)
         return jitted(state, inputs, labels)
 
     # AOT handle (jax .lower convention): lets callers inspect the compiled
     # artifact — e.g. count the all-reduce ops to verify fusion bucketing
-    # survived compilation (tests/test_fusion.py pins this).
+    # survived compilation (tests/test_fusion.py pins this; with
+    # accum_steps > 1 the count proves the psum sits outside the scan).
     step.lower = lambda state, batch: jitted.lower(state, *batch)
     return step
 
@@ -192,36 +383,41 @@ def _is_env_world(mesh) -> bool:
 
 
 def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
-                         metrics_fn):
+                         metrics_fn, accum_steps: int = 1,
+                         accum_unroll: Optional[int] = None,
+                         remat: Any = False):
     """Env-world train step: jit(grads) → host fused allreduce → jit(apply).
 
     The host gradient exchange uses the same fusion bucketing as the
     compiled path (``plan_buckets``: 64 MiB / same-dtype / order-preserving,
     ``HOROVOD_FUSION_THRESHOLD``), so the reference's tensor-fusion contract
-    (``docs/tensor-fusion.md``) holds for this plane too.
+    (``docs/tensor-fusion.md``) holds for this plane too. ``accum_steps``
+    scans microbatches inside the jitted gradient half exactly like the
+    single-controller step, and the per-step host round trip count is
+    unchanged — the accumulated tree rides one fused exchange, which is the
+    whole point of ``backward_passes_per_step`` on a negotiated plane.
     """
     from .ops.fusion import plan_buckets
 
     w = runtime.world()
+    vag = _build_value_and_grad(model, loss_fn, remat)
 
     def _grads(state: TrainState, inputs, labels):
-        def _loss(params, batch_stats):
-            variables = {"params": params}
-            if batch_stats is not None:
-                variables["batch_stats"] = batch_stats
-            step_rng = jax.random.fold_in(
-                jax.random.fold_in(jax.random.PRNGKey(0), state.step),
-                w.controller_rank)
-            out = model.apply(
-                variables, inputs, train=True,
-                mutable=["batch_stats"] if batch_stats is not None else [],
-                rngs={"dropout": step_rng})
-            logits, new_vars = out if isinstance(out, tuple) else (out, {})
-            return loss_fn(logits, labels), (logits,
-                                             new_vars.get("batch_stats"))
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            _loss, has_aux=True)(state.params, state.batch_stats)
-        return loss, logits, new_stats, grads
+        step_rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
+            w.controller_rank)
+        if accum_steps == 1:
+            (loss, (logits, new_stats)), grads = vag(
+                state.params, state.batch_stats, inputs, labels, step_rng)
+            extras = (metrics_fn(logits, labels)
+                      if metrics_fn is not None else {})
+        else:
+            loss, new_stats, grads, extras = _accumulate_grads(
+                vag, state.params, state.batch_stats, inputs, labels,
+                lambda i: jax.random.fold_in(step_rng, i),
+                accum_steps, metrics_fn, unroll=accum_unroll)
+            extras = extras if extras is not None else {}
+        return loss, extras, new_stats, grads
 
     def _apply(state: TrainState, grads, new_stats):
         updates, new_opt_state = dist_opt.update(
@@ -250,7 +446,9 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
     def step(state: TrainState, batch):
         import numpy as np
         inputs, labels = batch
-        loss, logits, new_stats, grads = grads_jit(state, inputs, labels)
+        if accum_steps > 1:
+            _check_accum_batch(inputs, accum_steps, 1)
+        loss, extras, new_stats, grads = grads_jit(state, inputs, labels)
 
         # Host-plane fused gradient averaging (the MPI_Allreduce analog).
         # Every bucket and metric is SUBMITTED before anything is waited on:
@@ -276,11 +474,10 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         metric_handles = {"loss": w.coord.submit(
             "allreduce", np.asarray(loss, np.float32),
             f"metric.loss.{tag}", op=Op.AVERAGE)}
-        if metrics_fn is not None:
-            for k, v in metrics_fn(logits, labels).items():
-                metric_handles[k] = w.coord.submit(
-                    "allreduce", np.asarray(v, np.float32),
-                    f"metric.{k}.{tag}", op=Op.AVERAGE)
+        for k, v in extras.items():
+            metric_handles[k] = w.coord.submit(
+                "allreduce", np.asarray(v, np.float32),
+                f"metric.{k}.{tag}", op=Op.AVERAGE)
 
         reduced = [None] * len(leaves)
         for bi, bucket in enumerate(buckets):
@@ -365,15 +562,29 @@ def shard_batch(batch, mesh: Optional[jax.sharding.Mesh] = None):
     """Place a global host batch onto the world, leading axis split across
     ranks. In env-world mode (independent processes) each process takes its
     own contiguous slice — the multi-process encoding of the same split."""
+    return make_batch_placer(mesh)(batch)
+
+
+def make_batch_placer(mesh: Optional[jax.sharding.Mesh] = None) -> Callable:
+    """Build a reusable host-batch placer (the hoisted form of
+    :func:`shard_batch`): the mesh lookup, env-world probe and
+    ``NamedSharding`` construction happen ONCE, and the returned callable
+    just ``device_put``s — so a per-batch loop (eval, prefetch) does no
+    re-sharding bookkeeping on the host per batch."""
     mesh = mesh if mesh is not None else runtime.mesh()
     if _is_env_world(mesh):
         w = runtime.world()
 
-        def _slice(x):
-            per = x.shape[0] // w.size
-            r = w.controller_rank
-            return jax.device_put(x[r * per:(r + 1) * per])
-        return jax.tree_util.tree_map(_slice, batch)
+        def _slice_batch(batch):
+            def _slice(x):
+                per = x.shape[0] // w.size
+                r = w.controller_rank
+                return jax.device_put(x[r * per:(r + 1) * per])
+            return jax.tree_util.tree_map(_slice, batch)
+        return _slice_batch
     sharding = NamedSharding(mesh, P(AXIS))
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sharding), batch)
+
+    def _place(batch):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch)
+    return _place

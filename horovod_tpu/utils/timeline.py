@@ -42,6 +42,29 @@ class _State:
     ACTIVITY = 3
 
 
+# Host-plane phase names (beyond the reference's collective activities):
+# the overlapped training hot path emits these so a trace shows WHAT the
+# host was doing while the device ran — input staging and checkpointing,
+# the two host activities PR 3 moved off the step's critical path.
+H2D = "H2D"                      # prefetch thread: host→device batch copy
+CKPT_SNAPSHOT = "CKPT_SNAPSHOT"  # step loop: device→host state snapshot
+CKPT_WRITE = "CKPT_WRITE"        # background writer: orbax write + GC
+
+
+@contextlib.contextmanager
+def maybe_op(tl: Optional["Timeline"], tensor_name: str, op_kind: str):
+    """Scoped :meth:`Timeline.op` that no-ops when ``tl`` is None — the
+    emitters on the training hot path (prefetch thread, checkpoint writer)
+    run with or without a timeline and must not branch at every call site.
+    Each concurrent emitter uses its own ``tensor_name`` row, so the
+    per-row state machine never sees interleaved ops from two threads."""
+    if tl is None:
+        yield None
+        return
+    with tl.op(tensor_name, op_kind):
+        yield tl
+
+
 class TimelineStateError(RuntimeError):
     """Illegal timeline transition — a B event would be left unbalanced
     (the reference asserts these transitions, ``timeline.h:37-42`` enforced

@@ -1,0 +1,222 @@
+"""In-step gradient accumulation — the TPU-native ``backward_passes_per_step``
+(Sergeev & Del Balso 2018 §4; ISSUE 3 tentpole).
+
+Pinned properties:
+
+* **Equivalence**: ``accum_steps=N`` on the world is bit-close (allclose,
+  fp32 accumulation) to the full-batch step for N ∈ {1, 2, 4}, including
+  the ``average=True`` world scaling, ``average=False``, metric extras and
+  a remat policy.
+* **One collective per accumulated step**: the lowered HLO contains exactly
+  ``len(plan_buckets(grads)) + len(metrics)`` all-reduces regardless of N —
+  the psum sits OUTSIDE the microbatch scan.
+* **Scaling**: ``DistributedOptimizer(accum_steps=N)`` divides a gradient
+  sum by the global microbatch count (N × size with ``average=True``).
+"""
+
+import re
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import training
+from horovod_tpu.ops.fusion import plan_buckets
+
+
+class _MLP(nn.Module):
+    """No BN/dropout: the microbatch mean is exactly the full-batch mean,
+    so accumulation must reproduce the full-batch step to fp tolerance."""
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(10)(x)
+
+
+class _BNNet(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = nn.Dense(8)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return nn.Dense(10)(x)
+
+
+def _batch(rows=32, features=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(rows, features).astype(np.float32),
+            rng.randint(0, 10, (rows,)))
+
+
+def _run_step(model, batch, accum_steps, sample_shape=(2, 8), **kw):
+    """Fresh identically-initialized state → one accumulated step."""
+    hvd.init()
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros(sample_shape),
+        optax.sgd(0.1), average=kw.pop("average", True))
+    step = training.make_train_step(model, dist_opt,
+                                    accum_steps=accum_steps, **kw)
+    new_state, metrics = step(state, training.shard_batch(batch))
+    return jax.device_get(new_state), jax.device_get(metrics)
+
+
+def _assert_trees_close(a, b, **tol):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(x, y, **tol)
+
+
+def test_accum_equivalence_to_full_batch():
+    """accum_steps ∈ {2, 4} reproduce the full-batch step: params, loss
+    AND metric extras (which average over microbatches) allclose."""
+    model = _MLP()
+    batch = _batch()
+    mfn = lambda logits, labels: {"acc": training.accuracy(logits, labels)}
+    ref_state, ref_metrics = _run_step(model, batch, 1, metrics_fn=mfn)
+    for n in (2, 4):
+        st, m = _run_step(model, batch, n, metrics_fn=mfn)
+        _assert_trees_close(st.params, ref_state.params,
+                            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(m["loss"], ref_metrics["loss"],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(m["acc"], ref_metrics["acc"], rtol=1e-5)
+
+
+def test_accum_integer_metric_not_zeroed():
+    """Integer metric leaves keep the microbatch SUM — the full-batch value.
+    A fractional integer mean (1/N cast to int32 == 0) would silently zero
+    every count-style metric under accumulation."""
+    model = _MLP()
+    batch = _batch(seed=11)
+    mfn = lambda logits, labels: {
+        "label_sum": jnp.sum(labels).astype(jnp.int32)}
+    _, ref = _run_step(model, batch, 1, metrics_fn=mfn)
+    assert float(ref["label_sum"]) > 0
+    for n in (2, 4):
+        _, m = _run_step(model, batch, n, metrics_fn=mfn)
+        np.testing.assert_allclose(m["label_sum"], ref["label_sum"],
+                                   rtol=1e-6)
+
+
+def test_accum_equivalence_average_false():
+    """average=False (world SUM) composes with the 1/N microbatch mean the
+    same way the full-batch step does."""
+    model = _MLP()
+    batch = _batch(seed=3)
+    ref_state, _ = _run_step(model, batch, 1, average=False)
+    st, _ = _run_step(model, batch, 4, average=False)
+    _assert_trees_close(st.params, ref_state.params, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_remat_equivalence():
+    """jax.checkpoint over the microbatch forward recomputes, never
+    changes, the gradients."""
+    model = _MLP()
+    batch = _batch(seed=5)
+    ref_state, _ = _run_step(model, batch, 2)
+    st, _ = _run_step(model, batch, 2, remat=True)
+    _assert_trees_close(st.params, ref_state.params, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_batch_stats_updated_per_microbatch():
+    """BN under accumulation: statistics thread sequentially through the
+    scan (N momentum updates per step — the documented semantics, NOT
+    bit-equal to one full-batch update), and the step stays finite."""
+    model = _BNNet()
+    batch = _batch(seed=7)
+    hvd.init()
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.sgd(0.1))
+    init_stats = jax.device_get(state.batch_stats)
+    step = training.make_train_step(model, dist_opt, accum_steps=2)
+    new_state, metrics = step(state, training.shard_batch(batch))
+    assert np.isfinite(float(metrics["loss"]))
+    new_stats = jax.device_get(new_state.batch_stats)
+    changed = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(init_stats),
+                        jax.tree_util.tree_leaves(new_stats)))
+    assert changed, "batch_stats were not updated by the accumulated step"
+    for leaf in jax.tree_util.tree_leaves(new_stats):
+        assert np.all(np.isfinite(leaf))
+
+
+def _lowered_allreduce_count(step, state, batch) -> int:
+    txt = step.lower(state, batch).as_text()
+    return len(re.findall(r"\ball_reduce\b", txt))
+
+
+def test_exactly_one_fused_allreduce_per_accum_step():
+    """The acceptance-criterion pin: the gradient psum fires ONCE per
+    accumulated step (outside the scan) — the lowered artifact has
+    len(plan_buckets(grads)) all-reduces for gradients + 1 for the loss
+    metric, independent of accum_steps."""
+    hvd.init()
+    model = _MLP()
+    batch = (jnp.zeros((32, 8)), jnp.zeros((32,), jnp.int32))
+    counts = {}
+    for n in (1, 2, 4):
+        state, dist_opt = training.create_train_state(
+            model, jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.sgd(0.1))
+        step = training.make_train_step(model, dist_opt, accum_steps=n)
+        counts[n] = _lowered_allreduce_count(step, state, batch)
+    expect = len(plan_buckets(jax.tree_util.tree_leaves(state.params))) + 1
+    assert counts == {1: expect, 2: expect, 4: expect}, counts
+    # Default 64 MiB threshold fuses the whole MLP gradient into ONE bucket:
+    # a single all-reduce group carries the accumulated gradient tree.
+    assert expect == 2
+
+
+def test_accum_divisibility_error_is_eager_and_clear():
+    hvd.init()
+    model = _MLP()
+    state, dist_opt = training.create_train_state(
+        model, jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.sgd(0.1))
+    step = training.make_train_step(model, dist_opt, accum_steps=4)
+    bad = (jnp.zeros((40, 8)), jnp.zeros((40,), jnp.int32))
+    with pytest.raises(ValueError, match="microbatches"):
+        step(state, bad)
+    with pytest.raises(ValueError, match="accum_steps"):
+        training.make_train_step(model, dist_opt, accum_steps=0)
+    # Setting the knob on BOTH layers would divide gradients by N twice —
+    # rejected eagerly instead of silently training at LR/N.
+    from horovod_tpu.optimizer import DistributedOptimizer
+    both = DistributedOptimizer(optax.sgd(0.1), accum_steps=2)
+    with pytest.raises(ValueError, match="BOTH"):
+        training.make_train_step(model, both, accum_steps=2)
+
+
+def test_distributed_optimizer_accum_steps_scaling():
+    """DistributedOptimizer(accum_steps=N): a gradient SUM over N backward
+    passes is averaged by the global microbatch count (N × size under
+    average=True; N under average=False+no-op psum of identical ranks is
+    N/size... asserted numerically for both flags)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.optimizer import DistributedOptimizer
+    hvd.init()
+    world = hvd.size()
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    grad_sum = {"w": jnp.full((4,), 8.0, jnp.float32)}  # 4 microbatches × 2.0
+
+    for average, want in ((True, 2.0), (False, 2.0 * world)):
+        opt = DistributedOptimizer(optax.sgd(1.0), accum_steps=4,
+                                   average=average)
+        ostate = opt.init(params)
+
+        def f(g):
+            updates, _ = opt.update(g, ostate, params)
+            return updates
+
+        updates = jax.jit(jax.shard_map(
+            f, mesh=hvd.mesh(), in_specs=(P(),), out_specs=P(),
+            check_vma=False))(grad_sum)
+        np.testing.assert_allclose(np.asarray(updates["w"]), -want,
+                                   rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        DistributedOptimizer(optax.sgd(1.0), accum_steps=0)

@@ -141,6 +141,51 @@ def test_shard_iterator_places_on_world():
     np.testing.assert_array_equal(np.asarray(x), batches[0][0])
 
 
+def test_prefetch_sharding_places_on_world_from_worker():
+    """sharding= : the prefetch worker itself performs the (sharded)
+    device_put, so H2D overlaps the consuming step instead of running
+    synchronously at next(). Values and placement must match
+    shard_batch's."""
+    from horovod_tpu import runtime, training
+    hvd.init()
+    rng = np.random.RandomState(0)
+    host = [(rng.randn(16, 4).astype(np.float32),
+             rng.randint(0, 10, (16,))) for _ in range(3)]
+    out = list(prefetch_to_device(iter(host), 2,
+                                  sharding=runtime.ranked_sharding()))
+    assert len(out) == 3
+    for (hx, hy), (dx, dy) in zip(host, out):
+        np.testing.assert_array_equal(np.asarray(dx), hx)
+        np.testing.assert_array_equal(np.asarray(dy), hy)
+        ref = training.shard_batch((hx, hy))
+        assert dx.sharding == ref[0].sharding
+        assert dy.sharding == ref[1].sharding
+
+
+def test_prefetch_emits_h2d_timeline_phase(tmp_path):
+    """Each worker-side placement is bracketed by an H2D phase so traces
+    can attribute input-bound vs compute-bound steps (bin/profile_step.py
+    --timeline)."""
+    import json
+    from horovod_tpu import runtime
+    from horovod_tpu.utils.timeline import Timeline
+    hvd.init()
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    host = [(np.zeros((8, 2), np.float32), np.zeros((8,), np.int32))
+            for _ in range(4)]
+    list(prefetch_to_device(iter(host), 2,
+                            sharding=runtime.ranked_sharding(),
+                            timeline=tl))
+    tl.close()
+    events = [e for e in json.load(open(path)) if isinstance(e, dict)]
+    h2d_b = [e for e in events
+             if e.get("ph") == "B" and e.get("name") == "H2D"]
+    ends = [e for e in events if e.get("ph") == "E"]
+    assert len(h2d_b) == 4, h2d_b
+    assert len(ends) == len(h2d_b)
+
+
 def test_prefetch_composes_with_training_loop():
     import optax
     from horovod_tpu import models, training
