@@ -86,4 +86,7 @@ from .exceptions import (  # noqa: F401
     ServerOverloadedError,
     DeadlineExceededError,
     ServerClosedError,
+    CheckpointCorruptError,
+    CheckpointTimeoutError,
+    NonFiniteGradError,
 )

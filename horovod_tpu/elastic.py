@@ -46,7 +46,8 @@ import sys
 from typing import Any, Callable, Optional
 
 from . import runtime
-from .exceptions import StalledError, TransportError, WorkerFailureError
+from .exceptions import (CheckpointCorruptError, StalledError,
+                         TransportError, WorkerFailureError)
 
 RECOVERABLE = (WorkerFailureError, StalledError, TransportError)
 
@@ -89,6 +90,16 @@ class ElasticState:
         # two-phase contract — the marker is written by the writer's
         # on_durable hook, strictly after the checkpoint bytes are down.
         self.writer = writer
+        # Committed-but-corrupt checkpoints skipped by the verified
+        # fallback walk (bit rot / truncation AFTER the two-phase commit
+        # finished — the marker proves the write completed, the manifest
+        # proves what the bytes said then).
+        self.discarded_corrupt = 0
+        # Steps THIS rank's walk has proven against their manifests. The
+        # cross-rank min in latest_committed can land BELOW this rank's
+        # own verified candidate (another rank's commit lagged) — such a
+        # step must still be verified at restore time.
+        self._verified_steps: set = set()
 
     # -- layout ------------------------------------------------------------
     def _dir(self) -> str:
@@ -171,6 +182,13 @@ class ElasticState:
                     os.unlink(self._marker(s))
                 except OSError:
                     pass
+        # Deterministic corruption drills (HVD_FAULT_SPEC ckpt:* clauses)
+        # fire here — strictly AFTER the two-phase commit finished, which
+        # is the scenario the verified fallback exists for: a marker that
+        # promises bytes the disk no longer honors.
+        from .testing import faults as _faults
+        _faults.ckpt_hook(step, os.path.join(self._dir(), f"ckpt_{step}"),
+                          self._marker(step))
 
     def wait(self) -> None:
         """Barrier for async commits: returns once every enqueued commit is
@@ -192,12 +210,37 @@ class ElasticState:
                     continue
         return sorted(steps)
 
-    def _local_latest(self) -> Optional[int]:
-        """Newest step with BOTH a marker and its checkpoint directory."""
+    def _local_latest(self, verify: bool = True) -> Optional[int]:
+        """Newest step with a marker, its checkpoint directory, AND (when
+        ``verify``) bytes that match the integrity manifest.
+
+        The verified fallback walk: a committed step whose checkpoint
+        fails verification — truncated by a dying writer's filesystem,
+        bit-flipped on disk, or deliberately corrupted by a
+        ``ckpt:*`` fault drill — is logged, counted in
+        ``discarded_corrupt``, and SKIPPED, so the newest-checkpoint
+        corruption costs one walk iteration instead of the whole run.
+        Each verification is a full read of that checkpoint; the walk
+        runs once per restore attempt, not per training step.
+        """
+        from .parallel import checkpoint as _ckpt
         base = self._dir()
         for s in reversed(self._marked_steps()):
-            if os.path.isdir(os.path.join(base, f"ckpt_{s}")):
+            path = os.path.join(base, f"ckpt_{s}")
+            if not os.path.isdir(path):
+                continue
+            if not verify:
                 return s
+            try:
+                _ckpt.verify_checkpoint(path)
+            except CheckpointCorruptError as e:
+                self.discarded_corrupt += 1
+                print(f"[elastic] committed step {s} failed integrity "
+                      f"verification — discarding and walking back "
+                      f"({e})", file=sys.stderr, flush=True)
+                continue
+            self._verified_steps.add(s)
+            return s
         return None
 
     def advance(self, n: int = 1) -> None:
@@ -208,13 +251,17 @@ class ElasticState:
             self.commit()
 
     def latest_committed(self) -> Optional[int]:
-        """Highest step EVERY rank has committed (None = no common commit).
+        """Highest step EVERY rank has committed AND can verify (None =
+        no common verified commit).
 
         A failure can land between one rank's commit and another's, so
         per-rank latests may differ by one commit; the world-wide minimum
         is the only step all ranks can restore together. Only steps whose
         two-phase commit finished (marker present) count — a torn write
-        from a rank killed mid-checkpoint is invisible here.
+        from a rank killed mid-checkpoint is invisible here — and each
+        rank additionally verifies its candidate against the integrity
+        manifest, walking back past committed-but-corrupt steps
+        (:meth:`_local_latest`).
         """
         self.wait()  # async commits in flight count once durable, not before
         mine = self._local_latest()
@@ -227,18 +274,43 @@ class ElasticState:
         return mine
 
     def restore(self, step: Optional[int] = None) -> "ElasticState":
-        """Restore params/opt_state/step from the last common commit (or
-        an explicit ``step``) onto the current trees' shardings."""
+        """Restore params/opt_state/step from the last common VERIFIED
+        commit (or an explicit ``step``) onto the current trees'
+        shardings.
+
+        With ``step=None`` the restore skips re-verification only when
+        this rank's fallback walk already proved the chosen step (one
+        full read, not two); the cross-rank min can land BELOW this
+        rank's verified candidate — another rank's commit lagged — and
+        such a step IS verified here before being trusted. An explicit
+        ``step`` is always verified and raises
+        :class:`~horovod_tpu.exceptions.CheckpointCorruptError` if its
+        bytes no longer match the manifest — the caller asked for THAT
+        step, so walking back silently would violate the request.
+        """
         from .parallel import checkpoint as _ckpt
         self.wait()
+        explicit = step is not None
         if step is None:
             step = self.latest_committed()
         if step is None:
             raise FileNotFoundError(
+                f"no committed elastic state under {self.directory} "
+                f"survived integrity verification"
+                if self.discarded_corrupt else
                 f"no committed elastic state under {self.directory}")
-        self.params, self.opt_state, self.step = _ckpt.restore_sharded(
-            self._dir(), self.params, self.opt_state, step=int(step))
+        self._restore_step(int(step), force_verify=explicit)
         return self
+
+    def _restore_step(self, step: int, force_verify: bool = False) -> None:
+        """Restore ``step`` onto the current trees, verifying unless this
+        rank's fallback walk already proved that exact step — the one
+        place the restore-vs-reverify decision lives (both :meth:`restore`
+        and :func:`run_with_recovery` come through here)."""
+        from .parallel import checkpoint as _ckpt
+        self.params, self.opt_state, self.step = _ckpt.restore_sharded(
+            self._dir(), self.params, self.opt_state, step=step,
+            verify=force_verify or step not in self._verified_steps)
 
 
 def run_with_recovery(train_fn: Callable[[ElasticState], Any],
@@ -259,7 +331,15 @@ def run_with_recovery(train_fn: Callable[[ElasticState], Any],
     """
     committed = state.latest_committed()  # one cross-rank agreement round
     if committed is not None:
-        state.restore(committed)
+        # _restore_step skips the second verify pass only when THIS
+        # rank's walk proved the agreed step; the cross-rank min can be
+        # a step this rank never verified (its own candidate was newer),
+        # and a corrupt local copy of it must raise, not restore.
+        state._restore_step(int(committed))
+        if state.discarded_corrupt:
+            print(f"[elastic] discarded {state.discarded_corrupt} "
+                  f"committed-but-corrupt checkpoint(s); resuming from "
+                  f"verified step {state.step}", flush=True)
         if restart_epoch() > 0:
             print(f"[elastic] restart epoch {restart_epoch()}: resumed "
                   f"from committed step {state.step}", flush=True)

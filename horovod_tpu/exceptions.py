@@ -104,6 +104,64 @@ class ServerClosedError(HorovodError):
     """
 
 
+class CheckpointCorruptError(HorovodError):
+    """A checkpoint's bytes do not match its integrity manifest.
+
+    Raised by the verify half of the checkpoint integrity plane
+    (:func:`horovod_tpu.parallel.checkpoint.verify_checkpoint`): every
+    save writes a per-leaf checksum manifest alongside the bytes, and a
+    restore that finds a truncated file, a flipped bit, or a
+    structure/dtype/shape mismatch raises this instead of silently
+    resuming from poisoned state. The message names the checkpoint path
+    and the first offending leaf.
+
+    The reference's resume scan trusts whatever directory listing it
+    finds (``keras_imagenet_resnet50.py:47-56``) — a torn write from a
+    killed rank restores as garbage. Here the elastic restore chain
+    (:meth:`horovod_tpu.elastic.ElasticState.restore`) catches this and
+    walks back to the newest checkpoint that DOES verify, so a corrupt
+    newest checkpoint costs one restore attempt, not the run.
+    """
+
+    def __init__(self, path: str, detail: str = ""):
+        self.path = path
+        self.detail = detail
+        msg = f"checkpoint {path} failed integrity verification"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class CheckpointTimeoutError(HorovodError):
+    """An async checkpoint write did not become durable within the
+    caller's deadline.
+
+    Raised by :meth:`horovod_tpu.trainer.AsyncCheckpointer.wait` when a
+    ``timeout=`` is given and the background writer is still in flight
+    when it expires — a hung filesystem (dead NFS mount, wedged object
+    store) otherwise blocks the durability barrier forever. The write
+    itself is NOT cancelled: the writer thread keeps going, and a later
+    ``wait()`` observes whatever it eventually did (success or the
+    re-raised error).
+    """
+
+
+class NonFiniteGradError(HorovodError):
+    """Too many consecutive non-finite-gradient steps with no checkpoint
+    to roll back to.
+
+    The in-jit bad-step guard (``make_train_step(guard_nonfinite=True)``)
+    skips the optimizer update whenever any replica's gradients carry a
+    NaN/Inf, leaving params bit-unchanged. ``Trainer.fit`` counts
+    consecutive skips; after ``HVD_MAX_BAD_STEPS`` of them it rolls back
+    to the last verified elastic checkpoint — or, when no
+    :class:`horovod_tpu.elastic.ElasticState` is attached, raises this:
+    a persistent NaN source (bad data shard, broken loss scale, flaky
+    chip) is not going to fix itself, and silently skipping forever
+    would burn the reservation training nothing.
+    """
+
+
 class StalledError(HorovodError):
     """A collective waited past the hard stall deadline (strict mode).
 

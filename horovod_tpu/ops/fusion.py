@@ -100,7 +100,8 @@ def _prescale_array(x, prescale):
 def fused_allreduce(tree, average: bool = True,
                     fusion_threshold: Optional[int] = None,
                     axis_name: str = AXIS,
-                    prescale: Optional[float] = None):
+                    prescale: Optional[float] = None,
+                    return_finite: bool = False):
     """Allreduce a pytree with fusion bucketing. Compiled-context only
     (it is the gradient hot path inside the jitted train step).
 
@@ -114,15 +115,33 @@ def fused_allreduce(tree, average: bool = True,
     same traversal (the reference's ``backward_passes_per_step`` divides by
     the global microbatch count at the same point). The reduce is linear, so
     pre- and post-scaling are equivalent; prescaling keeps the bucketed tree
-    the single thing the collective ever sees."""
+    the single thing the collective ever sees.
+
+    ``return_finite=True`` returns ``(reduced_tree, all_finite)`` where
+    ``all_finite`` is a scalar bool, True iff every float leaf of EVERY
+    rank's input was finite — the in-jit bad-step guard's signal. It is
+    folded into the same bucket traversal with **zero extra collectives**:
+    the reduce is a sum, and IEEE754 sums propagate any NaN/Inf operand
+    into the result (Inf−Inf pairs become NaN, overflow becomes Inf), so
+    checking ``isfinite`` on each REDUCED bucket while still flat — one
+    pass per bucket, before unfusing — sees every rank's poison through
+    the psum that already happened. The flag is therefore identical on
+    all replicas, which is exactly what a divergence-free skip-step
+    decision needs."""
     from .sparse import IndexedSlices, allreduce_indexed_slices
 
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, IndexedSlices))
     if not leaves:
-        return tree
+        return (tree, jnp.ones((), jnp.bool_)) if return_finite else tree
     op = Op.AVERAGE if average else Op.SUM
     reduced: List[Optional[jax.Array]] = [None] * len(leaves)
+    finite = jnp.ones((), jnp.bool_)
+
+    def _check(x):
+        nonlocal finite
+        if return_finite and jnp.issubdtype(x.dtype, jnp.inexact):
+            finite = finite & jnp.all(jnp.isfinite(x))
 
     dense_idx = [i for i, l in enumerate(leaves)
                  if not isinstance(l, IndexedSlices)]
@@ -131,20 +150,28 @@ def fused_allreduce(tree, average: bool = True,
         if prescale is not None:
             s = IndexedSlices(_prescale_array(s.values, prescale),
                               s.indices, s.dense_shape)
-        reduced[i] = allreduce_indexed_slices(
+        r = allreduce_indexed_slices(
             s, average=average, axis_name=axis_name)
+        # Allgathered slices carry every rank's raw values, so a local
+        # NaN is literally present in each rank's gathered copy.
+        _check(r.values)
+        reduced[i] = r
 
     dense = [leaves[i] for i in dense_idx]
     buckets = plan_buckets(dense, fusion_threshold)
     for bucket in buckets:
         if len(bucket) == 1:
             j = bucket[0]
-            reduced[dense_idx[j]] = _reduce_in_trace(
+            r = _reduce_in_trace(
                 _prescale_array(dense[j], prescale), op, axis_name)
+            _check(r)
+            reduced[dense_idx[j]] = r
         else:
             members = [dense[j] for j in bucket]
             flat = _reduce_in_trace(
                 _prescale_array(_fuse(members), prescale), op, axis_name)
+            _check(flat)
             for j, r in zip(bucket, _unfuse(flat, members)):
                 reduced[dense_idx[j]] = r
-    return jax.tree_util.tree_unflatten(treedef, reduced)
+    out = jax.tree_util.tree_unflatten(treedef, reduced)
+    return (out, finite) if return_finite else out

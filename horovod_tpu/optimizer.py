@@ -120,15 +120,36 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         return optimizer.init(params)
 
     def update_fn(grads, state, params=None, **extra):
-        grads = allreduce_gradients(
-            grads, average=average, fusion_threshold=fusion_threshold,
-            sparse_as_dense=sparse_as_dense, compression=compression,
-            accum_steps=accum_steps, axis_name=axis_name)
+        # ``finite_out``: the bad-step guard's side channel. When
+        # ``make_train_step(guard_nonfinite=True)`` passes a dict here,
+        # the fused allreduce additionally derives the world-wide
+        # all-finite flag from the ALREADY-reduced buckets (same psum
+        # round, zero extra collectives — see fused_allreduce) and this
+        # function deposits it under ``"all_finite"`` for the step to
+        # gate params/opt_state on. In-trace only: the dict holds a
+        # tracer for the duration of the surrounding trace.
+        finite_out = extra.pop("finite_out", None)
+        if finite_out is None:
+            grads = allreduce_gradients(
+                grads, average=average, fusion_threshold=fusion_threshold,
+                sparse_as_dense=sparse_as_dense, compression=compression,
+                accum_steps=accum_steps, axis_name=axis_name)
+        else:
+            grads, all_finite = allreduce_gradients(
+                grads, average=average, fusion_threshold=fusion_threshold,
+                sparse_as_dense=sparse_as_dense, compression=compression,
+                accum_steps=accum_steps, axis_name=axis_name,
+                return_finite=True)
+            finite_out["all_finite"] = all_finite
         return optimizer.update(grads, state, params, **extra)
 
     # Stamp the knob where make_train_step can see it: setting accum_steps
     # on BOTH layers would silently divide gradients by N twice.
     update_fn.accum_steps = accum_steps
+    # Capability stamp for the guard: make_train_step only threads the
+    # finite_out channel into optimizers that declare it (a plain optax
+    # transformation would choke on the unknown kwarg).
+    update_fn.supports_finite_out = True
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -138,28 +159,43 @@ def allreduce_gradients(grads,
                         sparse_as_dense: bool = False,
                         compression: Any = Compression.none,
                         accum_steps: int = 1,
-                        axis_name: str = AXIS):
+                        axis_name: str = AXIS,
+                        return_finite: bool = False):
     """Allreduce a gradient pytree: dense leaves via fused flat buckets,
     sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``).
     ``accum_steps > 1`` divides by the local microbatch count (the caller
     passes a gradient *sum* over N backward passes) as a prescale fused
-    into the bucket traversal."""
+    into the bucket traversal. ``return_finite=True`` additionally
+    returns the world-wide all-finite scalar derived inside the same
+    traversal (see :func:`~horovod_tpu.ops.fusion.fused_allreduce`)."""
     prescale = None if accum_steps <= 1 else 1.0 / accum_steps
     if runtime.is_initialized() and runtime.size() == 1 \
             and not runtime._in_world_trace():
         # size()==1 fast path (__init__.py:180-182) — but the microbatch
-        # mean is not a cross-rank concern and must still happen.
-        if prescale is None:
+        # mean is not a cross-rank concern and must still happen, and
+        # neither is finiteness: check the (scaled) local tree directly.
+        if prescale is None and not return_finite:
             return grads
         from .ops.fusion import _prescale_array
 
         def _scale(l):
+            if prescale is None:
+                return l
             if _is_sparse_leaf(l):
                 return IndexedSlices(_prescale_array(l.values, prescale),
                                      l.indices, l.dense_shape)
             return _prescale_array(l, prescale)
-        return jax.tree_util.tree_map(_scale, grads,
-                                      is_leaf=_is_sparse_leaf)
+        scaled = jax.tree_util.tree_map(_scale, grads,
+                                        is_leaf=_is_sparse_leaf)
+        if not return_finite:
+            return scaled
+        finite = jnp.ones((), jnp.bool_)
+        for l in jax.tree_util.tree_leaves(scaled,
+                                           is_leaf=_is_sparse_leaf):
+            v = l.values if _is_sparse_leaf(l) else l
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                finite = finite & jnp.all(jnp.isfinite(v))
+        return scaled, finite
 
     if sparse_as_dense:
         grads = jax.tree_util.tree_map(
@@ -186,11 +222,15 @@ def allreduce_gradients(grads,
     # through the two-allgather sparse path.
     reduced = fused_allreduce(compressed, average=average,
                               fusion_threshold=fusion_threshold,
-                              axis_name=axis_name, prescale=prescale)
-    return jax.tree_util.tree_map(
+                              axis_name=axis_name, prescale=prescale,
+                              return_finite=return_finite)
+    if return_finite:
+        reduced, all_finite = reduced
+    out = jax.tree_util.tree_map(
         lambda l, c: l if _is_sparse_leaf(l)
         else compression.decompress(l, c.dtype),
         reduced, ctx_tree, is_leaf=_is_sparse_leaf)
+    return (out, all_finite) if return_finite else out
 
 
 def broadcast_global_variables(variables, root_rank: int = 0,

@@ -20,18 +20,214 @@ view races.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional, Tuple
+import zlib
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from .. import runtime
+from ..exceptions import CheckpointCorruptError
 from ..trainer import apply_retention, latest_checkpoint_step
 
 
 def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"ckpt_{step}")
+
+
+# ---------------------------------------------------------------------------
+# Integrity manifests (Check-N-Run-style, Eisenman et al. NSDI '22): every
+# save writes a per-leaf checksum manifest alongside the checkpoint bytes, so
+# a restore can PROVE the bytes it is about to trust are the bytes that were
+# written — torn writes, truncation and bit rot are routine at fleet scale,
+# and orbax's tensorstore layout does not end-to-end-checksum array data (a
+# flipped byte in a ``d/`` chunk restores "successfully" as garbage).
+# ---------------------------------------------------------------------------
+
+MANIFEST_NAME = "hvd_manifest.json"
+
+
+def _leaf_crc(leaf: Any) -> Optional[int]:
+    """CRC32 of a leaf's canonical serialized bytes, or None when the leaf
+    is not host-readable (a non-fully-addressable jax.Array in a
+    multi-process world — its record still pins structure/dtype/shape)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        return None
+    arr = np.asarray(leaf)
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _leaf_records(tree: Any) -> List[dict]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    records = []
+    for path, leaf in flat:
+        arr_like = (leaf if isinstance(leaf, jax.Array)
+                    else np.asarray(leaf))
+        records.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": list(np.shape(arr_like)),
+            "dtype": str(np.asarray(leaf).dtype
+                         if not isinstance(leaf, jax.Array)
+                         else leaf.dtype),
+            "crc32": _leaf_crc(leaf),
+        })
+    return records
+
+
+def write_manifest(path: str, tree: Any, step: Optional[int] = None) -> str:
+    """Write the integrity manifest for the checkpoint at ``path``.
+
+    Called by both checkpoint flavors (``trainer.save_checkpoint`` and
+    :func:`save_sharded`) strictly AFTER the orbax write finalizes and
+    strictly BEFORE the elastic two-phase commit marker — a marker-bearing
+    step therefore always has a manifest, and a crash at any point leaves
+    either no manifest (step not committed, invisible to restore) or a
+    complete one. The manifest lives INSIDE the checkpoint directory so
+    retention GC removes it with the bytes it describes.
+
+    Records the tree's per-leaf CRC32/shape/dtype plus the world and mesh
+    shape that wrote it (diagnostic metadata: elastic restarts may
+    legitimately restore onto a different world, so verification checks
+    leaves, not worlds).
+    """
+    meta: dict = {"format": 1, "leaves": _leaf_records(tree)}
+    if step is not None:
+        meta["step"] = int(step)
+    if runtime.is_initialized():
+        meta["world_size"] = runtime.size()
+        try:
+            meta["mesh_shape"] = dict(runtime.mesh().shape)
+        except Exception:  # noqa: BLE001 — metadata only, never fatal
+            meta["mesh_shape"] = None
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path)
+    return manifest_path
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """Load the manifest for the checkpoint at ``path``; None when the
+    checkpoint predates integrity manifests (legacy, unverifiable)."""
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        return None
+    try:
+        with open(manifest_path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            path, f"unreadable manifest {MANIFEST_NAME}: {e!r}") from e
+
+
+def _verify_leaves(path: str, manifest: dict, restored_tree: Any,
+                   subset: bool = False) -> int:
+    """Match restored leaves against manifest records; raises
+    :class:`CheckpointCorruptError` naming the first offending leaf.
+
+    Matching is a multiset over (shape, dtype, crc), not a path-by-path
+    walk: orbax restores container types structurally (dataclasses and
+    NamedTuples come back as dicts/lists), so save-time and restore-time
+    keypaths need not be comparable — but the bytes must be. ``subset``
+    allows the restored tree to cover only part of the manifest (the
+    partial ``restore_for_inference`` read). Returns the number of leaves
+    whose CRC was actually checked.
+    """
+    expected: dict = {}
+    for rec in manifest.get("leaves", []):
+        key = (tuple(rec["shape"]), str(rec["dtype"]))
+        expected.setdefault(key, []).append(rec)
+    flat, _ = jax.tree_util.tree_flatten_with_path(restored_tree)
+    if not subset:
+        n_expected = sum(len(v) for v in expected.values())
+        if len(flat) != n_expected:
+            raise CheckpointCorruptError(
+                path, f"manifest records {n_expected} leaves but the "
+                      f"checkpoint restored {len(flat)}")
+    checked = 0
+    for keypath, leaf in flat:
+        name = jax.tree_util.keystr(keypath)
+        arr = np.asarray(leaf)
+        key = (tuple(arr.shape), str(arr.dtype))
+        candidates = expected.get(key)
+        if not candidates:
+            # A scalar's container type may not round-trip (0-d float32
+            # saved as a python scalar restores as float64) — retry under
+            # each manifest dtype with a value-preserving cast.
+            recast = [(k, rs) for k, rs in expected.items()
+                      if k[0] == tuple(arr.shape) and rs]
+            for k, rs in recast:
+                try:
+                    cast = np.asarray(leaf, dtype=np.dtype(k[1]))
+                except (TypeError, ValueError):
+                    continue
+                crc = zlib.crc32(np.ascontiguousarray(cast).tobytes())
+                hit = next((r for r in rs if r["crc32"] == crc), None)
+                if hit is not None:
+                    rs.remove(hit)
+                    checked += 1
+                    break
+            else:
+                raise CheckpointCorruptError(
+                    path, f"leaf {name} with shape {arr.shape} dtype "
+                          f"{arr.dtype} matches no manifest record")
+            continue
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+        hit = next((r for r in candidates if r["crc32"] == crc), None)
+        if hit is None:
+            # Unverifiable records (crc None — non-addressable at save
+            # time) match any leaf of their shape/dtype.
+            hit = next((r for r in candidates if r["crc32"] is None), None)
+            if hit is None:
+                want = ", ".join(r["path"] for r in candidates[:3])
+                raise CheckpointCorruptError(
+                    path, f"leaf {name} (shape {arr.shape}, dtype "
+                          f"{arr.dtype}) CRC mismatch — bytes differ from "
+                          f"what the manifest recorded for {want}")
+            candidates.remove(hit)
+            continue
+        candidates.remove(hit)
+        checked += 1
+    return checked
+
+
+def verify_checkpoint(path: str, *, allow_unverified: bool = True) -> bool:
+    """Verify the checkpoint at ``path`` against its integrity manifest.
+
+    Reads the full checkpoint into host memory (raw numpy, no template)
+    and checks every leaf's CRC32/shape/dtype plus the leaf count against
+    the manifest. Raises :class:`CheckpointCorruptError` naming the path
+    and the offending leaf on any mismatch — including an orbax read that
+    fails outright (truncated metadata, missing chunk files).
+
+    Returns True when verification ran, False for a manifest-less legacy
+    checkpoint (tolerated when ``allow_unverified``, raised otherwise).
+    This is a full-read operation: the restore chain calls it once per
+    restore attempt, not per step.
+    """
+    import orbax.checkpoint as ocp
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(path, "checkpoint directory missing")
+    manifest = read_manifest(path)
+    if manifest is None:
+        if allow_unverified:
+            return False
+        raise CheckpointCorruptError(
+            path, f"no {MANIFEST_NAME} — cannot verify integrity")
+    try:
+        restored = ocp.PyTreeCheckpointer().restore(path)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any read failure IS corruption
+        raise CheckpointCorruptError(
+            path, f"unreadable checkpoint: {type(e).__name__}: {e}") from e
+    _verify_leaves(path, manifest, restored)
+    return True
 
 
 def snapshot_to_host(tree: Any, timeline: Any = None) -> Any:
@@ -56,13 +252,36 @@ def save_sharded(directory: str, step: int, params: Any,
 
     Every process participates (orbax writes each process's addressable
     shards); retention mirrors ``trainer.save_checkpoint`` and runs on
-    rank 0 only.
+    rank 0 only. After the orbax write finalizes, rank 0 writes the
+    per-leaf integrity manifest (:func:`write_manifest`) into the
+    checkpoint directory — strictly before any elastic commit marker, so
+    a marker-bearing step is always verifiable.
     """
     import orbax.checkpoint as ocp
     path = _ckpt_path(directory, step)
+    tree = {"params": params, "opt_state": opt_state}
+    if all(not isinstance(l, jax.Array) or l.is_fully_addressable
+           for l in jax.tree_util.tree_leaves(tree)):
+        # One bulk device→host fetch feeds BOTH the orbax write and the
+        # manifest CRCs; letting the manifest's per-leaf np.asarray run
+        # against the device tree would transfer the whole state a
+        # second time per commit. Restore placement is unaffected —
+        # restore_sharded lays leaves out from the TEMPLATE's
+        # ArrayRestoreArgs, not the saved arrays' sharding. Skipped in
+        # multi-process worlds: the orbax save must see the global
+        # jax.Arrays there (each process contributes its shards), and
+        # non-addressable leaves never pay a host fetch anyway (their
+        # manifest CRC is None).
+        tree = snapshot_to_host(tree)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, {"params": params, "opt_state": opt_state},
-               force=True)
+    ckptr.save(path, tree, force=True)
+    if (not runtime.is_initialized()
+            or runtime.world().controller_rank == 0
+            or runtime.world().env_world):
+        # Rank 0 owns the shared directory in a jax.distributed world;
+        # env-world ranks each own a PRIVATE directory and must manifest
+        # their own copy (elastic restore verifies per-rank).
+        write_manifest(path, tree, step=step)
     if (not runtime.is_initialized()
             or runtime.world().controller_rank == 0):
         apply_retention(directory, path, max_to_keep)
@@ -71,7 +290,8 @@ def save_sharded(directory: str, step: int, params: Any,
 
 def restore_sharded(directory: str, params_template: Any,
                     opt_state_template: Any,
-                    step: Optional[int] = None
+                    step: Optional[int] = None,
+                    verify: bool = True
                     ) -> Tuple[Any, Any, int]:
     """Restore (params, opt_state) onto the template trees' shardings.
 
@@ -80,6 +300,11 @@ def restore_sharded(directory: str, params_template: Any,
     discarded). Returns ``(params, opt_state, step)``; in a multi-process
     world the resolved step comes from rank 0's directory scan, so all
     ranks agree even when the shared filesystem is eventually consistent.
+
+    ``verify`` (default on) checks the integrity manifest first and
+    raises :class:`~horovod_tpu.exceptions.CheckpointCorruptError` on a
+    mismatch instead of silently resuming from garbage; pass False when
+    the caller already verified this step (the elastic fallback walk).
     """
     import orbax.checkpoint as ocp
     if step is None:
@@ -90,6 +315,8 @@ def restore_sharded(directory: str, params_template: Any,
     if step is None:
         raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _ckpt_path(directory, int(step))
+    if verify:
+        verify_checkpoint(path)
     template = {"params": params_template, "opt_state": opt_state_template}
 
     def _restore_args(x):
@@ -128,6 +355,13 @@ def restore_for_inference(directory: str, step: Optional[int] = None, *,
     replicated) — so a model too big for one chip serves sharded across
     the slice with zero model-code changes. Without ``mesh``, plain host
     numpy comes back (single-host serving).
+
+    A truncated or otherwise unreadable checkpoint raises
+    :class:`~horovod_tpu.exceptions.CheckpointCorruptError` naming the
+    path — never a raw orbax/tensorstore traceback — and when an
+    integrity manifest is present the restored serving subtrees are
+    CRC-verified against it (a subset check: the training-only subtrees
+    stay unread, which is the point of the partial restore).
     """
     import orbax.checkpoint as ocp
     if step is None:
@@ -140,17 +374,29 @@ def restore_for_inference(directory: str, step: Optional[int] = None, *,
     # restore of just the serving subtrees: for an Adam-style optimizer
     # the opt_state is ~2x the params, so a full read would triple the
     # restore I/O and peak host memory of every server start.
-    meta = ckptr.metadata(path)
+    try:
+        meta = ckptr.metadata(path)
+    except Exception as e:  # noqa: BLE001 — surface as corruption, named
+        raise CheckpointCorruptError(
+            path, f"unreadable checkpoint metadata: "
+                  f"{type(e).__name__}: {e}") from e
     if "params" not in meta:
         raise ValueError(
             f"{path} has no 'params' subtree — not a checkpoint this "
             f"framework wrote (keys: {sorted(meta)})")
     item = {k: meta[k] for k in ("params", "batch_stats")
             if meta.get(k) is not None}
-    variables = ckptr.restore(
-        path, item=item, transforms={},
-        restore_args=jax.tree_util.tree_map(lambda _: ocp.RestoreArgs(),
-                                            item))
+    try:
+        variables = ckptr.restore(
+            path, item=item, transforms={},
+            restore_args=jax.tree_util.tree_map(lambda _: ocp.RestoreArgs(),
+                                                item))
+    except Exception as e:  # noqa: BLE001
+        raise CheckpointCorruptError(
+            path, f"unreadable checkpoint: {type(e).__name__}: {e}") from e
+    manifest = read_manifest(path)
+    if manifest is not None:
+        _verify_leaves(path, manifest, variables, subset=True)
     if mesh is None:
         return variables
     from .mesh import named_sharding_tree

@@ -122,6 +122,10 @@ class Engine:
                 timeline = runtime.world().timeline
         self._timeline = timeline
         self._closed = False
+        # Readiness for load balancers (/healthz): a cold engine serves
+        # lazily but pays compiles under traffic — routable means warmup()
+        # completed AND shutdown hasn't begun.
+        self._warmed = False
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="hvd-serve-dispatch",
                                         daemon=True)
@@ -166,7 +170,21 @@ class Engine:
                         f"apply_fn output leaf shape {leaf.shape} has no "
                         f"leading batch axis of {b}; the engine cannot "
                         f"split it back into per-request rows")
+        self._warmed = True
         return self._buckets
+
+    def health(self) -> Tuple[bool, str, int]:
+        """Readiness triple ``(ready, status, queue_depth)`` for the
+        ``/healthz`` endpoint: ``(False, "warming", ...)`` until
+        :meth:`warmup` completes (a cold engine answers, but every first
+        bucket hit pays a compile — a load balancer must not route to
+        it), ``(False, "draining", ...)`` once :meth:`shutdown` began,
+        ``(True, "ok", ...)`` otherwise."""
+        if self._closed:
+            return False, "draining", len(self._queue)
+        if not self._warmed:
+            return False, "warming", len(self._queue)
+        return True, "ok", len(self._queue)
 
     # -- client API --------------------------------------------------------
 

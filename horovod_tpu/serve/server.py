@@ -13,6 +13,11 @@ status codes so clients see conventional semantics:
 * shut down (:class:`ServerClosedError`) → 503 with a terminal hint
 * bad shape/JSON → 400
 * ``GET /stats`` → 200, the engine's snapshot dict as JSON
+* ``GET /healthz`` → readiness probe: **503** before ``warmup()``
+  completes and once drain/shutdown begins, 200 with the current queue
+  depth otherwise — so a load balancer stops routing to a cold engine
+  (first bucket hits pay a compile) or a dying one (new requests would
+  race the drain)
 """
 
 from __future__ import annotations
@@ -44,8 +49,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        if self.path.split("?", 1)[0].rstrip("/") == "/stats":
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/stats":
             self._reply(200, self.engine.stats())
+        elif path == "/healthz":
+            ready, status, depth = self.engine.health()
+            self._reply(200 if ready else 503,
+                        {"status": status, "queue_depth": depth})
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 

@@ -11,15 +11,25 @@ This module turns an env spec into precise failures:
     HVD_FAULT_SPEC=coord:mute@step=2           # coordinator stops acking
     HVD_FAULT_SPEC=coord:delay_ms=50           # slow coordination plane
     HVD_FAULT_SPEC=rank=0:exit@step=4@epoch=1  # only on restart epoch 1
+    HVD_FAULT_SPEC=ckpt:truncate@step=5        # tear the step-5 checkpoint
+    HVD_FAULT_SPEC=ckpt:flip@step=5            # flip one byte in it
+    HVD_FAULT_SPEC=ckpt:drop_marker@step=5     # lose its commit marker
 
-Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>`` or
-``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``. Step-scoped actions
+Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>``,
+``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``, or
+``ckpt:<truncate|flip|drop_marker>@step=<s>``. Step-scoped actions
 REQUIRE ``@step`` (a clause that could never fire is rejected loudly);
 ``delay_ms`` is unconditional — it has no step context and rejects
 ``@step``. Every clause takes an optional ``@epoch=<e>`` suffix
 (default 0) matched against ``HVD_RESTART_EPOCH`` — so a kill drill fires
 on the first launch and NOT again after ``tpurun --restarts`` relaunches
 the world.
+
+``ckpt`` clauses corrupt the just-committed checkpoint for the matching
+step, strictly AFTER the two-phase commit completes (marker on disk) —
+modeling post-commit bit rot / torn replication, the failure class the
+integrity manifests + verified fallback restore exist for. They fire on
+every rank (each env-world rank owns a private checkpoint copy).
 
 Actions:
 
@@ -39,13 +49,15 @@ Actions:
 
 Hooks: :func:`step_hook` is called once per training step by
 ``Trainer.fit`` and by elastic training loops; :func:`coord_delay` is
-called by ``CoordClient.submit``. Both are near-zero-cost no-ops when
-``HVD_FAULT_SPEC`` is unset.
+called by ``CoordClient.submit``; :func:`ckpt_hook` is called by
+``ElasticState`` right after each two-phase commit finishes. All are
+near-zero-cost no-ops when ``HVD_FAULT_SPEC`` is unset.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import os
 import signal
 import time
@@ -53,7 +65,9 @@ from typing import List, Optional
 
 ENV_VAR = "HVD_FAULT_SPEC"
 
-_ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms")
+_ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms",
+            "truncate", "flip", "drop_marker")
+_CKPT_ACTIONS = ("truncate", "flip", "drop_marker")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,10 +96,10 @@ def parse_spec(text: str) -> List[Fault]:
                 raise FaultSpecError(
                     f"{ENV_VAR}: bad rank in clause {clause!r}") from None
             target = "rank"
-        elif target != "coord":
+        elif target not in ("coord", "ckpt"):
             raise FaultSpecError(
                 f"{ENV_VAR}: clause {clause!r} must start with "
-                f"'rank=<r>:' or 'coord:'")
+                f"'rank=<r>:', 'coord:' or 'ckpt:'")
         if not rest:
             raise FaultSpecError(f"{ENV_VAR}: clause {clause!r} has no action")
         parts = rest.split("@")
@@ -119,6 +133,14 @@ def parse_spec(text: str) -> List[Fault]:
         if target == "rank" and rank is None:
             raise FaultSpecError(
                 f"{ENV_VAR}: rank clause {clause!r} missing rank number")
+        if (action in _CKPT_ACTIONS) != (target == "ckpt"):
+            # Checkpoint corruption only makes sense on the ckpt target
+            # (it fires from the commit hook, not the step hook), and the
+            # ckpt target supports nothing else.
+            raise FaultSpecError(
+                f"{ENV_VAR}: clause {clause!r} — actions {_CKPT_ACTIONS} "
+                f"require (and are the only actions of) the 'ckpt:' "
+                f"target")
         if action == "delay_ms" and step is not None:
             # The delay applies to EVERY submit (there is no step context
             # inside the coordination-plane client); accepting @step here
@@ -204,6 +226,8 @@ def step_hook(step: int) -> None:
         return
     epoch = _restart_epoch()
     for i, f in enumerate(faults):
+        if f.target == "ckpt":
+            continue  # fires from ckpt_hook on the commit path instead
         if f.action == "delay_ms" or f.step != step or f.epoch != epoch:
             continue
         if f.target == "rank" and f.rank != _my_rank():
@@ -215,6 +239,89 @@ def step_hook(step: int) -> None:
             continue
         _fired.add(key)
         _fire(f)
+
+
+def reset() -> None:
+    """Forget which faults already fired (tests re-run drills in one
+    process; production worlds never need this)."""
+    _fired.clear()
+
+
+def _ckpt_data_file(ckpt_dir: str) -> Optional[str]:
+    """The checkpoint's largest array-data file — the corruption target.
+
+    Prefers tensorstore ``d/`` chunk files (real array bytes, the case
+    integrity CRCs — not orbax — must catch); falls back to the largest
+    file of any kind (truncating metadata models a torn write).
+    """
+    chunks = [f for f in glob.glob(os.path.join(ckpt_dir, "**", "d", "*"),
+                                   recursive=True) if os.path.isfile(f)]
+    if not chunks:
+        chunks = [f for f in glob.glob(os.path.join(ckpt_dir, "**", "*"),
+                                       recursive=True)
+                  if os.path.isfile(f)
+                  and os.path.basename(f) != "hvd_manifest.json"]
+    return max(chunks, key=os.path.getsize, default=None)
+
+
+def _corrupt_checkpoint(fault: Fault, ckpt_dir: str, marker: str) -> None:
+    tag = f"epoch {_restart_epoch()} step {fault.step}"
+    if fault.action == "drop_marker":
+        print(f"[faults] rank {_my_rank()}: dropping commit marker "
+              f"{os.path.basename(marker)} at {tag}", flush=True)
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        return
+    victim = _ckpt_data_file(ckpt_dir)
+    if victim is None:
+        print(f"[faults] rank {_my_rank()}: no data file to corrupt "
+              f"under {ckpt_dir} at {tag}", flush=True)
+        return
+    size = os.path.getsize(victim)
+    if fault.action == "truncate":
+        print(f"[faults] rank {_my_rank()}: truncating "
+              f"{os.path.relpath(victim, ckpt_dir)} {size}->{size // 2} "
+              f"bytes at {tag}", flush=True)
+        with open(victim, "r+b") as f:
+            f.truncate(size // 2)
+    else:  # flip
+        off = size // 2
+        with open(victim, "r+b") as f:
+            f.seek(off)
+            b = f.read(1) or b"\x00"
+            f.seek(off)
+            # Increment, not XOR: in a shared-directory jax.distributed
+            # world every rank's commit hook corrupts the SAME byte, and
+            # an even number of self-inverting XORs would restore it —
+            # a drill that silently tests nothing. k increments stay
+            # corrupt for any k not a multiple of 256.
+            f.write(bytes([(b[0] + 1) & 0xFF]))
+        print(f"[faults] rank {_my_rank()}: flipped byte {off} of "
+              f"{os.path.relpath(victim, ckpt_dir)} at {tag}", flush=True)
+
+
+def ckpt_hook(step: int, ckpt_dir: str, marker: str) -> None:
+    """Fire any ``ckpt:*`` clause scoped to the checkpoint just committed
+    at ``step``. Called by ``ElasticState`` immediately after the
+    two-phase commit finishes (bytes + manifest + marker all durable), so
+    the corruption models post-commit rot — the marker keeps promising
+    bytes the disk no longer honors, which the verified fallback restore
+    must survive. No-op (one dict lookup) unless ``HVD_FAULT_SPEC`` has a
+    ``ckpt:`` clause."""
+    faults = _active()
+    if not faults:
+        return
+    epoch = _restart_epoch()
+    for i, f in enumerate(faults):
+        if f.target != "ckpt" or f.step != step or f.epoch != epoch:
+            continue
+        key = (i, epoch)
+        if key in _fired:
+            continue
+        _fired.add(key)
+        _corrupt_checkpoint(f, ckpt_dir, marker)
 
 
 def coord_delay() -> None:

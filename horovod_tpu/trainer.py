@@ -12,6 +12,7 @@ checkpointing (SURVEY §5.4) via orbax.
 from __future__ import annotations
 
 import atexit
+import dataclasses
 import os
 import queue
 import sys
@@ -36,7 +37,9 @@ class Trainer:
                  *, eval_step: Optional[Callable] = None,
                  steps_per_epoch: Optional[int] = None,
                  verbose: Optional[bool] = None,
-                 prefetch: int = 2):
+                 prefetch: int = 2,
+                 max_bad_steps: Optional[int] = None,
+                 elastic: Any = None):
         self.train_step = train_step
         self.eval_step = eval_step
         self.state = state
@@ -60,6 +63,19 @@ class Trainer:
         # synchronizes on a metric value.
         self._metric_add = None
         self._eval_placer: Optional[Callable] = None
+        # Bad-step containment (active only when the train step was built
+        # with guard_nonfinite and emits the ``bad_step`` metric): a
+        # device-resident consecutive-skip counter, the budget beyond
+        # which a NaN storm stops being "transient" (HVD_MAX_BAD_STEPS),
+        # and optionally an ElasticState to roll back onto — its verified
+        # fallback walk guarantees the rollback target's bytes are good.
+        from .utils import config as _config
+        self.max_bad_steps = (_config.max_bad_steps()
+                              if max_bad_steps is None
+                              else max(1, int(max_bad_steps)))
+        self.elastic = elastic
+        self._bad_counter = None
+        self._bad_add = None
 
     def _stream(self, data: Iterable):
         from .data import prefetch_to_device, shard_iterator
@@ -94,6 +110,81 @@ class Trainer:
                 lambda a, x: a + jnp.asarray(x, jnp.float32), acc, m))
         return self._metric_add(sums, metrics)
 
+    # -- bad-step containment (guard_nonfinite train steps) ----------------
+
+    def _track_bad_step(self, bad_flag) -> bool:
+        """Fold this step's ``bad_step`` flag into the device-resident
+        consecutive-skip counter; returns True when the step was skipped.
+        The ``int()`` fetch of one scalar per step is the whole host-side
+        cost of containment. Exceeding ``max_bad_steps`` consecutive
+        skips triggers :meth:`_contain` (rollback or raise)."""
+        if self._bad_add is None:
+            self._bad_add = jax.jit(
+                lambda c, b: jnp.where(jnp.asarray(b) > 0, c + 1,
+                                       jnp.zeros_like(c)))
+            self._bad_counter = jnp.zeros((), jnp.int32)
+        self._bad_counter = self._bad_add(self._bad_counter, bad_flag)
+        consec = int(self._bad_counter)
+        if consec == 0:
+            return False
+        tl = runtime.world().timeline if runtime.is_initialized() else None
+        with _timeline.maybe_op(tl, "train.guard", _timeline.BAD_STEP):
+            pass  # instantaneous marker: this step was skipped
+        if self.verbose:
+            print(f"[trainer] non-finite gradients at global step "
+                  f"{self._global_step}: update skipped "
+                  f"({consec}/{self.max_bad_steps} consecutive)",
+                  file=sys.stderr, flush=True)
+        if consec >= self.max_bad_steps:
+            self._contain(consec)
+        return True
+
+    def _contain(self, consec: int) -> None:
+        """The bad-step budget is exhausted: the params (or the data
+        pipeline feeding them) are presumed poisoned beyond what
+        skip-steps can absorb. With an attached
+        :class:`~horovod_tpu.elastic.ElasticState`, roll back to the last
+        checkpoint that PASSES integrity verification (the fallback walk)
+        and keep training; without one, raise
+        :class:`~horovod_tpu.exceptions.NonFiniteGradError` — skipping
+        forever would burn the reservation training nothing."""
+        from .exceptions import NonFiniteGradError
+        if self.elastic is None:
+            raise NonFiniteGradError(
+                f"{consec} consecutive non-finite-gradient steps at "
+                f"global step {self._global_step} and no elastic state "
+                f"to roll back to — a persistent NaN source (bad data "
+                f"shard, broken loss scale, flaky chip) will not fix "
+                f"itself. Attach Trainer(elastic=ElasticState(...)) for "
+                f"automatic rollback, or raise HVD_MAX_BAD_STEPS if "
+                f"longer transients are expected")
+        es = self.elastic
+        # Current trees as restore templates (structure + sharding); the
+        # restore overwrites every value from the verified checkpoint.
+        es.params, es.opt_state = self.state.params, self.state.opt_state
+        try:
+            es.restore()   # latest_committed's walk skips corrupt steps
+        except FileNotFoundError as e:
+            # Elastic attached but nothing committed (or every commit
+            # corrupt): same terminal diagnosis as the no-elastic branch
+            # — a filesystem error would send the user hunting paths
+            # instead of the NaN source.
+            raise NonFiniteGradError(
+                f"{consec} consecutive non-finite-gradient steps at "
+                f"global step {self._global_step} and no verified "
+                f"committed checkpoint to roll back to ({e}) — commit "
+                f"via ElasticState before the storm, or fix the NaN "
+                f"source (bad data shard, broken loss scale, flaky "
+                f"chip)") from e
+        self.state = dataclasses.replace(
+            self.state, params=es.params, opt_state=es.opt_state,
+            step=jnp.asarray(es.step, self.state.step.dtype))
+        self._bad_counter = jnp.zeros((), jnp.int32)
+        if self.verbose:
+            print(f"[trainer] bad-step budget exhausted ({consec} "
+                  f"consecutive skips) — rolled back to verified "
+                  f"elastic step {es.step}", file=sys.stderr, flush=True)
+
     def fit(self, data: Callable[[], Iterable], epochs: int = 1,
             callbacks: Optional[List] = None,
             eval_data: Optional[Callable[[], Iterable]] = None,
@@ -121,6 +212,8 @@ class Trainer:
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             nsteps = 0
+            bad_steps = 0
+            guard_active = False
             metric_sums = None
             stream = self._stream(data())
             try:
@@ -131,8 +224,17 @@ class Trainer:
                     for cb in callbacks:
                         cb.on_batch_begin(batch_idx)
                     self.state, metrics = self.train_step(self.state, batch)
+                    # The guard's flag rides the metrics dict but is a
+                    # count, not a mean — pop it before the epoch
+                    # accumulator sees it.
+                    bad_flag = (metrics.pop("bad_step", None)
+                                if isinstance(metrics, dict) else None)
                     metric_sums = self._accumulate_metrics(metric_sums,
                                                            metrics)
+                    if bad_flag is not None:
+                        guard_active = True
+                        if self._track_bad_step(bad_flag):
+                            bad_steps += 1
                     for cb in callbacks:
                         cb.on_batch_end(batch_idx)
                     nsteps += 1
@@ -154,8 +256,14 @@ class Trainer:
             # retained step.
             logs: Dict[str, float] = {}
             if metric_sums is not None:
+                # Skipped steps contributed zeros to every metric sum (the
+                # step zeroes NaN-bearing metrics on a skip), so the mean
+                # is over the steps that actually trained.
+                good = max(1, nsteps - bad_steps)
                 for k, v in jax.device_get(metric_sums).items():
-                    logs[k] = float(v) / nsteps
+                    logs[k] = float(v) / good
+            if guard_active:
+                logs["bad_steps"] = float(bad_steps)
             if eval_data is not None and self.eval_step is not None:
                 if self._eval_placer is None:
                     # Hoisted: mesh lookup + NamedSharding construction
@@ -259,12 +367,36 @@ class AsyncCheckpointer:
             finally:
                 self._q.task_done()
 
-    def wait(self) -> None:
+    def wait(self, timeout: Optional[float] = None) -> None:
         """Barrier: returns once every submitted write is durable on disk,
         re-raising the first writer failure. Call before any restore (or
         before trusting the directory contents) — async means the bytes
-        land later, not that they may not land."""
-        self._q.join()
+        land later, not that they may not land.
+
+        With ``timeout`` (seconds), a write still in flight when the
+        deadline expires raises
+        :class:`~horovod_tpu.exceptions.CheckpointTimeoutError` instead
+        of blocking forever on a hung filesystem — the write itself is
+        NOT cancelled (the thread keeps going; a later ``wait()`` sees
+        its eventual outcome), but the caller gets control back to page
+        a human or fail over."""
+        if timeout is None:
+            self._q.join()
+        else:
+            deadline = time.monotonic() + timeout
+            # queue.Queue.join() has no timeout; wait on the same
+            # all_tasks_done condition it uses, with a deadline.
+            with self._q.all_tasks_done:
+                while self._q.unfinished_tasks:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        from .exceptions import CheckpointTimeoutError
+                        raise CheckpointTimeoutError(
+                            f"checkpoint write still in flight after "
+                            f"{timeout:.1f}s — filesystem hung or writer "
+                            f"wedged ({self._q.unfinished_tasks} job(s) "
+                            f"pending); the write was NOT cancelled")
+                    self._q.all_tasks_done.wait(remaining)
         if self._errors:
             raise self._errors.pop(0)
 
@@ -345,8 +477,12 @@ def save_checkpoint(directory: str, state: TrainState,
     def _write():
         # orbax writes into a tmp dir and renames on finalize, so a writer
         # killed mid-write never leaves a visible ckpt_<step> for the
-        # latest-step restore scan to trust.
+        # latest-step restore scan to trust. The integrity manifest lands
+        # right after the rename — before any elastic marker (which hangs
+        # off the writer's on_durable hook, strictly later).
+        from .parallel.checkpoint import write_manifest
         ocp.PyTreeCheckpointer().save(path, host, force=True)
+        write_manifest(path, host, step=step)
         apply_retention(directory, path, max_to_keep)
 
     if writer is None:
@@ -407,11 +543,18 @@ def latest_checkpoint_step(directory: str) -> Optional[int]:
 
 
 def restore_checkpoint(directory: str, state: TrainState,
-                       step: Optional[int] = None) -> TrainState:
+                       step: Optional[int] = None,
+                       verify: bool = True) -> TrainState:
     """Restore (on every rank, from the shared filesystem) then broadcast
     from rank 0 so all ranks are bit-identical — the reference's
     load-on-rank-0 + ``BroadcastGlobalVariablesCallback`` protocol
-    (``keras_imagenet_resnet50.py:130-133``)."""
+    (``keras_imagenet_resnet50.py:130-133``).
+
+    ``verify`` (default on) checks the checkpoint's integrity manifest
+    first and raises
+    :class:`~horovod_tpu.exceptions.CheckpointCorruptError` naming the
+    offending leaf instead of resuming from torn/bit-rotted bytes;
+    manifest-less legacy checkpoints restore unverified."""
     import orbax.checkpoint as ocp
     from .optimizer import broadcast_global_variables
     if step is None:
@@ -419,6 +562,9 @@ def restore_checkpoint(directory: str, state: TrainState,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = os.path.join(os.path.abspath(directory), f"ckpt_{step}")
+    if verify:
+        from .parallel.checkpoint import verify_checkpoint
+        verify_checkpoint(path)
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(
         path, item=jax.tree_util.tree_map(np.asarray, state))

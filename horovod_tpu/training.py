@@ -266,7 +266,8 @@ def make_train_step(model,
                     metrics_fn: Optional[Callable] = None,
                     accum_steps: int = 1,
                     accum_unroll: Optional[int] = None,
-                    remat: Any = False):
+                    remat: Any = False,
+                    guard_nonfinite: Optional[bool] = None):
     """Build the compiled SPMD train step.
 
     The returned function has signature ``step(state, batch) -> (state,
@@ -291,9 +292,33 @@ def make_train_step(model,
     pass ``True`` or a ``jax.checkpoint_policies`` policy) — activations are
     recomputed during backprop, trading ~⅓ more FLOPs for microbatch-sized
     rather than batch-sized activation memory (GPipe, Huang et al. 2019).
+
+    ``guard_nonfinite`` (default: ``HVD_GUARD_NONFINITE``) arms the in-jit
+    bad-step guard: the world-wide all-finite flag is derived from the
+    ALREADY-reduced fusion buckets (same psum round, zero extra
+    collectives — :func:`~horovod_tpu.ops.fusion.fused_allreduce`) and a
+    non-finite gradient tree on ANY replica leaves params, opt_state and
+    batch_stats bit-unchanged (skip-step; the step counter still
+    advances, so the next step's dropout keys differ). The step's metrics
+    gain a replica-identical ``bad_step`` scalar (1.0 = skipped) and the
+    other metric values are zeroed on skipped steps so a NaN loss cannot
+    poison the epoch mean; ``Trainer.fit`` turns consecutive skips into
+    rollback/abort containment (``HVD_MAX_BAD_STEPS``).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if guard_nonfinite is None:
+        from .utils import config as _config
+        guard_nonfinite = _config.guard_nonfinite()
+    if guard_nonfinite and not getattr(dist_opt.update,
+                                       "supports_finite_out", False):
+        raise ValueError(
+            "guard_nonfinite requires a DistributedOptimizer-wrapped "
+            "optimizer: the all-finite flag is derived inside its fused "
+            "allreduce so every replica agrees on the skip decision with "
+            "no extra collective; a plain optax transformation has no "
+            "such channel (wrap it with "
+            "horovod_tpu.DistributedOptimizer(...))")
     if accum_steps > 1 and getattr(dist_opt.update, "accum_steps", 1) > 1:
         raise ValueError(
             "accum_steps is set on BOTH make_train_step and "
@@ -322,19 +347,52 @@ def make_train_step(model,
                 accum_steps, metrics_fn, unroll=accum_unroll)
         # DistributedOptimizer performs the fused allreduce over `axis_name`
         # — on the accumulated (microbatch-mean) tree, once per step.
-        updates, new_opt_state = dist_opt.update(
-            grads, state.opt_state, state.params)
+        if guard_nonfinite:
+            finite_out: dict = {}
+            updates, new_opt_state = dist_opt.update(
+                grads, state.opt_state, state.params,
+                finite_out=finite_out)
+            all_finite = finite_out["all_finite"]
+        else:
+            updates, new_opt_state = dist_opt.update(
+                grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_stats = new_stats if new_stats is not None else state.batch_stats
         metrics = {"loss": jax.lax.pmean(loss, axis_name)}
         if extras is not None:
             metrics.update(jax.tree_util.tree_map(
                 lambda m: jax.lax.pmean(m, axis_name), extras))
+        if guard_nonfinite:
+            # Skip-step select: a scalar where() per leaf, which XLA fuses
+            # into the update elementwise ops — params/opt_state/batch_stats
+            # are bit-unchanged when any replica saw NaN/Inf. all_finite is
+            # replica-identical by construction (derived from the psum'd
+            # buckets), so every replica takes the same branch and NO extra
+            # collective is needed for the decision itself.
+            def _keep(new, old):
+                return jnp.where(all_finite, new, old)
+            new_params = jax.tree_util.tree_map(
+                _keep, new_params, state.params)
+            new_opt_state = jax.tree_util.tree_map(
+                _keep, new_opt_state, state.opt_state)
+            if state.batch_stats is not None:
+                new_stats = jax.tree_util.tree_map(
+                    _keep, new_stats, state.batch_stats)
+            # Metric hygiene: a skipped step's loss/extras are NaN-bearing
+            # by definition — zero them so the trainer's epoch accumulator
+            # stays finite (it divides by the GOOD step count), and expose
+            # the flag itself (already identical on every replica; a pmean
+            # here would add the very all-reduce the guard is pinned not
+            # to add).
+            metrics = jax.tree_util.tree_map(
+                lambda m: jnp.where(all_finite, m,
+                                    jnp.zeros_like(m)), metrics)
+            metrics["bad_step"] = 1.0 - all_finite.astype(jnp.float32)
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
             opt_state=new_opt_state,
-            batch_stats=new_stats if new_stats is not None
-            else state.batch_stats,
+            batch_stats=new_stats,
         )
         return new_state, metrics
 
@@ -352,7 +410,8 @@ def make_train_step(model,
         return _make_env_world_step(model, dist_opt, loss_fn, mesh,
                                     axis_name, metrics_fn,
                                     accum_steps=accum_steps,
-                                    accum_unroll=accum_unroll, remat=remat)
+                                    accum_unroll=accum_unroll, remat=remat,
+                                    guard_nonfinite=guard_nonfinite)
 
     n_shards = int(mesh.shape[axis_name]) if accum_steps > 1 else 1
 
@@ -385,7 +444,8 @@ def _is_env_world(mesh) -> bool:
 def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                          metrics_fn, accum_steps: int = 1,
                          accum_unroll: Optional[int] = None,
-                         remat: Any = False):
+                         remat: Any = False,
+                         guard_nonfinite: bool = False):
     """Env-world train step: jit(grads) → host fused allreduce → jit(apply).
 
     The host gradient exchange uses the same fusion bucketing as the
@@ -396,6 +456,12 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
     single-controller step, and the per-step host round trip count is
     unchanged — the accumulated tree rides one fused exchange, which is the
     whole point of ``backward_passes_per_step`` on a negotiated plane.
+
+    ``guard_nonfinite`` checks the REDUCED host buckets (the averaged sum
+    already carries every rank's NaN/Inf, so all ranks agree) and skips
+    the jitted apply half entirely on a bad step — params/opt_state stay
+    the same arrays, the step counter advances, and ``bad_step`` rides
+    the metrics dict exactly like the compiled plane.
     """
     from .ops.fusion import plan_buckets
 
@@ -480,8 +546,15 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                 f"metric.{k}.{tag}", op=Op.AVERAGE)
 
         reduced = [None] * len(leaves)
+        all_finite = True
         for bi, bucket in enumerate(buckets):
             out = np.asarray(w.coord.wait(handles[bi]))
+            if guard_nonfinite and np.issubdtype(out.dtype, np.inexact):
+                # Checked while still flat — one pass per REDUCED bucket,
+                # mirroring the compiled plane's in-trace check. The
+                # coordinator's average propagates any rank's NaN/Inf, so
+                # this flag is identical on every rank by construction.
+                all_finite = all_finite and bool(np.all(np.isfinite(out)))
             if len(bucket) == 1:
                 j = bucket[0]
                 reduced[j] = out.reshape(leaves[j].shape)
@@ -493,8 +566,20 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                     off += n
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
 
+        if guard_nonfinite and not all_finite:
+            # Skip-step: drain the metric collectives (every rank
+            # submitted them — the protocol must stay balanced), zero the
+            # NaN-bearing values, advance only the step counter.
+            for h in metric_handles.values():
+                w.coord.wait(h)
+            metrics = {k: np.zeros((), np.float32) for k in metric_handles}
+            metrics["bad_step"] = np.ones((), np.float32)
+            return dataclasses.replace(state, step=state.step + 1), metrics
+
         state = apply_jit(state, grads, new_stats)
         metrics = {k: w.coord.wait(h) for k, h in metric_handles.items()}
+        if guard_nonfinite:
+            metrics["bad_step"] = np.zeros((), np.float32)
         return state, metrics
 
     return step

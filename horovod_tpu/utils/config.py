@@ -63,6 +63,31 @@ def restart_epoch() -> int:
         return 0
 
 
+def guard_nonfinite() -> bool:
+    """``HVD_GUARD_NONFINITE`` — default for the in-jit bad-step guard
+    (``make_train_step(guard_nonfinite=...)``): skip the optimizer update
+    (params/opt_state bit-unchanged) whenever any replica's gradients
+    carry NaN/Inf. Off unless set to 1/true/yes — the guard itself adds
+    no collectives, but containment makes ``Trainer.fit`` fetch one
+    scalar per step to track consecutive skips."""
+    return os.environ.get("HVD_GUARD_NONFINITE", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+# Consecutive skipped (non-finite) steps tolerated before Trainer.fit
+# rolls back to the last verified checkpoint / raises NonFiniteGradError.
+DEFAULT_MAX_BAD_STEPS: int = 5
+
+
+def max_bad_steps() -> int:
+    """``HVD_MAX_BAD_STEPS`` — consecutive bad-step budget for the
+    containment path (default 5): a transient NaN burst shorter than this
+    is absorbed by skip-steps alone; a longer storm means the params (or
+    the data pipeline) are already wrong and the run rolls back to the
+    last verified checkpoint instead of skipping forever."""
+    return max(1, _int_env("HVD_MAX_BAD_STEPS", DEFAULT_MAX_BAD_STEPS))
+
+
 def stall_warning_secs() -> float:
     raw = os.environ.get("HOROVOD_STALL_CHECK_TIME")
     if raw:

@@ -49,6 +49,7 @@ class _State:
 H2D = "H2D"                      # prefetch thread: host→device batch copy
 CKPT_SNAPSHOT = "CKPT_SNAPSHOT"  # step loop: device→host state snapshot
 CKPT_WRITE = "CKPT_WRITE"        # background writer: orbax write + GC
+BAD_STEP = "BAD_STEP"            # guard: non-finite grads, update skipped
 
 
 @contextlib.contextmanager

@@ -194,6 +194,41 @@ def test_failed_write_leaves_no_marker(tmp_path, monkeypatch):
     w.close()
 
 
+def test_wait_timeout_on_stalled_writer(tmp_path):
+    """ISSUE 4 satellite: a hung filesystem must not block the durability
+    barrier forever — ``wait(timeout=)`` raises CheckpointTimeoutError,
+    the write is NOT cancelled, and a later unbounded ``wait()`` observes
+    its eventual completion."""
+    from horovod_tpu.exceptions import CheckpointTimeoutError
+    release = threading.Event()
+    w = AsyncCheckpointer()
+    w.submit(lambda: release.wait(30))  # the 'dead NFS mount'
+    t0 = time.perf_counter()
+    with pytest.raises(CheckpointTimeoutError, match="in flight"):
+        w.wait(timeout=0.2)
+    dt = time.perf_counter() - t0
+    assert dt < 5.0, f"timeout wait blocked for {dt:.1f}s"
+    release.set()          # filesystem comes back
+    w.wait(timeout=30)     # eventual outcome is still observable
+    w.close()
+
+
+def test_wait_timeout_noop_when_idle():
+    w = AsyncCheckpointer()
+    w.wait(timeout=0.1)    # nothing in flight: returns immediately
+    w.close()
+
+
+def test_wait_timeout_still_reraises_writer_error(tmp_path):
+    """A writer that FAILED before the deadline surfaces its error, not a
+    timeout — the deadline only covers writes genuinely in flight."""
+    w = AsyncCheckpointer()
+    w.submit(lambda: (_ for _ in ()).throw(IOError("disk gone")))
+    with pytest.raises(IOError, match="disk gone"):
+        w.wait(timeout=10)
+    w.close()
+
+
 def test_writer_close_then_submit_raises(tmp_path):
     w = AsyncCheckpointer()
     w.close()

@@ -479,6 +479,37 @@ class TestRestoreForInference:
 
 
 class TestHttpServer:
+    def test_healthz_readiness_lifecycle(self, model_and_vars):
+        """/healthz drives the load balancer: 503 'warming' before
+        warmup() completes (a cold engine answers /predict but pays
+        compiles under traffic), 200 with queue depth once warm, 503
+        'draining' the moment shutdown begins."""
+        m, v = model_and_vars
+        eng = _engine(m, v, max_batch=2, batch_timeout_ms=5)
+
+        def probe(url):
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=30) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            with serve.HttpServer(eng) as srv:
+                url = f"http://{srv.host}:{srv.port}"
+                code, body = probe(url)
+                assert code == 503 and body["status"] == "warming"
+                eng.warmup()
+                code, body = probe(url)
+                assert code == 200 and body["status"] == "ok"
+                assert body["queue_depth"] >= 0
+                eng.shutdown()
+                code, body = probe(url)
+                assert code == 503 and body["status"] == "draining"
+        finally:
+            eng.shutdown()
+
     def test_predict_and_stats(self, model_and_vars):
         m, v = model_and_vars
         eng = _engine(m, v, max_batch=4, batch_timeout_ms=5)
