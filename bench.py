@@ -102,6 +102,21 @@ def _peak_tflops_per_chip():
     return None
 
 
+def _peak_bytes_per_chip():
+    """Per-chip peak HBM bytes from the runtime's allocator stats, or None
+    where the backend keeps none (CPU). Read AFTER the measured region so
+    the number covers the train step — it is how the ZeRO memory win
+    (opt state ÷ world size) shows up in BENCH_*.json."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — stats are best-effort telemetry
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
 # Per-model TPU configs (the reference benchmark family, tf_cnn_benchmarks
 # --model {resnet50, resnet101, vgg16, inception3}; docs/benchmarks.md:5-6).
 _TPU_CONFIGS = {
@@ -179,7 +194,8 @@ def measure(devices=None, cfg=None) -> float:
     state, dist_opt = training.create_train_state(
         model, jax.random.PRNGKey(0),
         jnp.zeros((cfg["batch_per_chip"],) + x_shape[1:], jnp.float32),
-        optax.sgd(cfg.get("lr", 0.1), momentum=0.9))
+        optax.sgd(cfg.get("lr", 0.1), momentum=0.9),
+        zero=bool(cfg.get("zero", False)))
     accum = int(cfg.get("accum_steps", 1))
     if cfg["batch_per_chip"] % accum:
         raise SystemExit(
@@ -435,6 +451,13 @@ def main() -> None:
                         "accumulated step (docs/performance.md); the "
                         "per-chip batch is split, so the global batch per "
                         "optimizer update is unchanged")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 sharded optimizer updates: fused "
+                        "reduce-scatter + all-gather instead of the "
+                        "all-reduce, optimizer state rank-sharded to "
+                        "1/size per chip (docs/performance.md); recorded "
+                        "in the JSON line alongside peak_bytes_per_chip "
+                        "so the memory win is attributable")
     args = p.parse_args()
     if args.accum_steps < 1:
         raise SystemExit(f"--accum-steps must be >= 1, got "
@@ -445,6 +468,11 @@ def main() -> None:
                 "--accum-steps applies to the conv family (the "
                 "make_train_step path); the parallel transformer has its "
                 "own pipeline-microbatching knobs")
+        if args.zero:
+            raise SystemExit(
+                "--zero applies to the conv family (the "
+                "DistributedOptimizer path); the parallel transformer "
+                "shards its optimizer over the mesh already")
         if args.scaling:
             raise SystemExit(
                 "--scaling is not supported for transformer_lm (the conv "
@@ -454,6 +482,7 @@ def main() -> None:
         return
     cfg = _bench_config(args.model or "resnet50")
     cfg["accum_steps"] = args.accum_steps
+    cfg["zero"] = bool(args.zero)
     if args.conv_backend:
         if (args.model or "resnet50") not in ("resnet50", "resnet101"):
             raise SystemExit(
@@ -500,14 +529,19 @@ def main() -> None:
         # Also emit the standard absolute metric (full world) so parsers
         # keyed on it always find it.
         per_chip = rate / len(devs)
-        print(json.dumps({
+        line = {
             "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
             "value": round(per_chip, 2),
             "unit": "images/sec/chip",
             "vs_baseline": round(per_chip / _baseline_for(cfg["model"]),
                                  3),
             "accum_steps": int(cfg.get("accum_steps", 1)),
-        }))
+            "zero": bool(cfg.get("zero", False)),
+        }
+        peak_bytes = _peak_bytes_per_chip()
+        if peak_bytes is not None:
+            line["peak_bytes_per_chip"] = peak_bytes
+        print(json.dumps(line))
         return
 
     rate = measure(cfg=cfg)
@@ -518,7 +552,11 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / _baseline_for(cfg["model"]), 3),
         "accum_steps": int(cfg.get("accum_steps", 1)),
+        "zero": bool(cfg.get("zero", False)),
     }
+    peak_bytes = _peak_bytes_per_chip()
+    if peak_bytes is not None:
+        line["peak_bytes_per_chip"] = peak_bytes
     tflops = per_chip * TRAIN_GFLOP_PER_IMAGE[cfg["model"]] / 1e3
     line["tflops_per_chip"] = round(tflops, 1)
     peak = _peak_tflops_per_chip()

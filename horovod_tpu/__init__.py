@@ -56,10 +56,12 @@ from .ops.sparse import IndexedSlices  # noqa: F401
 from .optimizer import (  # noqa: F401
     Compression,
     DistributedOptimizer,
+    ZeroShardedState,
     allreduce_gradients,
     broadcast_global_variables,
     broadcast_parameters,
     broadcast_optimizer_state,
+    partition_optimizer,
 )
 from . import callbacks  # noqa: F401
 from . import data  # noqa: F401

@@ -73,6 +73,16 @@ class ElasticState:
     directory. ``restore()`` agrees cross-rank on the highest step every
     rank has committed, so a failure mid-write can roll back at most
     ``commit_every`` steps — never diverge.
+
+    ZeRO (rank-sharded) optimizer state composes: ``opt_state`` may carry
+    :class:`~horovod_tpu.optimizer.ZeroShardedState` nodes. Commits write
+    the canonical world-agnostic form with the same per-shard integrity
+    manifest (so the verified fallback walk covers the sharded state
+    too), and a single-controller restore RE-SHARDS onto whatever world
+    size the restarted run has — an elastic restart that comes back with
+    fewer chips resumes from the same bytes. Env-world commits hold only
+    this rank's physical shard and therefore restore at the same world
+    size only (``docs/checkpointing.md``).
     """
 
     def __init__(self, params: Any, opt_state: Any = None, step: int = 0,

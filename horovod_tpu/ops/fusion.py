@@ -20,19 +20,65 @@ neighbors, so the "fusion buffer" never exists as a separate persistent
 allocation. An oversized tensor becomes its own bucket (the reference
 likewise falls back to a direct non-fused collective for tensors above the
 threshold, ``mpi_ops.cc:1101-1105``).
+
+The same bucket planner also feeds the ZeRO-1 sharded-update plane
+(:class:`ZeroPlan`, :func:`fused_reduce_scatter`,
+:func:`fused_allgather_params`): reduce-scatter + all-gather spend the same
+bytes on the wire as the fused all-reduce while cutting optimizer-state
+memory and update FLOPs by the world size (``docs/performance.md``).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..runtime import AXIS
 from ..utils import config as _config
+from ..utils.compat import all_gather_invariant
 from .collectives import Op, _reduce_in_trace
+
+
+@functools.lru_cache(maxsize=512)
+def _plan_cached(key: Tuple[Tuple[Tuple[int, ...], str], ...],
+                 fusion_threshold: int) -> Tuple[Tuple[int, ...], ...]:
+    """The fusion scan, memoized. The plan is a pure function of the leaf
+    (shape, dtype) sequence and the threshold, so repeated traces and
+    eager per-step calls over the same gradient tree (every step of the
+    env-world plane, every re-trace of the compiled one) stop re-walking
+    the whole tree. Keyed on resolved values only — the env-var default
+    is resolved by the caller, so changing ``HOROVOD_FUSION_THRESHOLD``
+    between calls still takes effect."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_dtype = None
+    cur_bytes = 0
+    for i, (shape, dtype) in enumerate(key):
+        nbytes = int(math.prod(shape)) * np.dtype(dtype).itemsize
+        fusable = (
+            fusion_threshold > 0
+            and cur
+            and dtype == cur_dtype
+            and cur_bytes + nbytes <= fusion_threshold
+        )
+        if fusable:
+            cur.append(i)
+            cur_bytes += nbytes
+        else:
+            if cur:
+                buckets.append(cur)
+            cur = [i]
+            cur_dtype = dtype
+            cur_bytes = nbytes
+    if cur:
+        buckets.append(cur)
+    return tuple(tuple(b) for b in buckets)
 
 
 def plan_buckets(leaves: Sequence[jax.Array],
@@ -43,34 +89,16 @@ def plan_buckets(leaves: Sequence[jax.Array],
     queue in order; fuse while dtype matches and cumulative bytes stay within
     the threshold; close the bucket at the first non-fusable tensor.
     ``fusion_threshold=0`` disables fusion (one bucket per tensor).
+
+    The scan is cached per ``(shapes, dtypes, threshold)`` — see
+    :func:`_plan_cached`; callers get a fresh mutable copy each call, so
+    mutating a returned plan cannot poison the cache.
     """
     if fusion_threshold is None:
         fusion_threshold = _config.fusion_threshold_bytes()
-
-    buckets: List[List[int]] = []
-    cur: List[int] = []
-    cur_dtype = None
-    cur_bytes = 0
-    for i, leaf in enumerate(leaves):
-        nbytes = int(math.prod(leaf.shape)) * leaf.dtype.itemsize
-        fusable = (
-            fusion_threshold > 0
-            and cur
-            and leaf.dtype == cur_dtype
-            and cur_bytes + nbytes <= fusion_threshold
-        )
-        if fusable:
-            cur.append(i)
-            cur_bytes += nbytes
-        else:
-            if cur:
-                buckets.append(cur)
-            cur = [i]
-            cur_dtype = leaf.dtype
-            cur_bytes = nbytes
-    if cur:
-        buckets.append(cur)
-    return buckets
+    key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+                for leaf in leaves)
+    return [list(b) for b in _plan_cached(key, int(fusion_threshold))]
 
 
 def _fuse(leaves: Sequence[jax.Array]) -> jax.Array:
@@ -175,3 +203,220 @@ def fused_allreduce(tree, average: bool = True,
                 reduced[dense_idx[j]] = r
     out = jax.tree_util.tree_unflatten(treedef, reduced)
     return (out, finite) if return_finite else out
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded-update plane (Rajbhandari et al. 2020; Xu et al. 2020,
+# "Automatic Cross-Replica Sharding of Weight Update Computation"): the same
+# bucket planner that feeds the fused all-reduce instead feeds a
+# reduce-scatter — every rank receives the REDUCED 1/N slice of each flat
+# bucket, applies the optimizer update to its slice only, and the updated
+# slices ride one all-gather back into the full tree. Bytes on the wire are
+# unchanged (ring all-reduce = reduce-scatter + all-gather); optimizer-state
+# memory and update FLOPs drop by the world size.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroPlan:
+    """Static layout of a tree's rank-sharded flat buckets.
+
+    Everything here is trace-time constant (hashable, usable as pytree aux
+    data): ``buckets`` are :func:`plan_buckets` index groups over the
+    flattened tree, ``sizes``/``padded`` the true and rank-padded flat
+    length per bucket (``padded[i]`` is the smallest multiple of
+    ``nshards`` >= ``sizes[i]``, so ``lax.psum_scatter(tiled=True)`` splits
+    evenly), ``shapes``/``dtypes`` the member leaves' layout for unfusing,
+    and ``treedef`` the original tree structure."""
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    padded: Tuple[int, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[str, ...]
+    treedef: Any
+    nshards: int
+
+    def shard_len(self, i: int) -> int:
+        return self.padded[i] // self.nshards
+
+    def shard_shapes(self):
+        """Per-bucket ``(nshards, shard_len)`` — the stacked layout the
+        sharded optimizer state stores (leading axis split one shard per
+        rank over the world mesh)."""
+        return tuple((self.nshards, self.shard_len(i))
+                     for i in range(len(self.buckets)))
+
+
+def plan_zero(tree, nshards: int,
+              fusion_threshold: Optional[int] = None) -> ZeroPlan:
+    """Build the sharded-update layout for ``tree`` over ``nshards`` ranks.
+
+    Sparse (:class:`~horovod_tpu.ops.sparse.IndexedSlices`) leaves cannot
+    be flattened into rank-sharded dense buckets (their integer indices
+    must not be summed, and a slice of a slice has no owner rank) — a tree
+    carrying them raises; densify first (``sparse_as_dense``) or keep the
+    replicated optimizer for sparse models."""
+    from .sparse import IndexedSlices
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, IndexedSlices))
+    if any(isinstance(l, IndexedSlices) for l in leaves):
+        raise ValueError(
+            "ZeRO sharded updates require dense gradients: an "
+            "IndexedSlices leaf cannot be flattened into rank-sharded "
+            "buckets (densify with sparse_as_dense=True, or use the "
+            "replicated DistributedOptimizer for sparse models)")
+    if nshards < 1:
+        raise ValueError(f"nshards must be >= 1, got {nshards}")
+    buckets = plan_buckets(leaves, fusion_threshold)
+    sizes = []
+    padded = []
+    for b in buckets:
+        n = sum(int(math.prod(leaves[j].shape)) for j in b)
+        sizes.append(n)
+        padded.append(-(-n // nshards) * nshards)
+    return ZeroPlan(
+        buckets=tuple(tuple(b) for b in buckets),
+        sizes=tuple(sizes),
+        padded=tuple(padded),
+        shapes=tuple(tuple(l.shape) for l in leaves),
+        dtypes=tuple(str(jnp.dtype(l.dtype)) for l in leaves),
+        treedef=treedef,
+        nshards=nshards,
+    )
+
+
+def _fuse_bucket(leaves, plan: ZeroPlan, i: int):
+    """Flatten bucket ``i``'s members into one rank-padded flat vector."""
+    flat = _fuse([leaves[j] for j in plan.buckets[i]])
+    pad = plan.padded[i] - plan.sizes[i]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def fused_reduce_scatter(tree, plan: ZeroPlan, *,
+                         average: bool = True,
+                         axis_name: str = AXIS,
+                         prescale: Optional[float] = None,
+                         return_finite: bool = False):
+    """Reduce-scatter a pytree into this rank's flat bucket shards.
+
+    Each bucket is flattened, zero-padded to a multiple of the world size,
+    optionally prescaled (one fused multiply on the flat bucket — gradient
+    accumulation's ``1/accum_steps`` and ``average``'s ``1/size`` fold into
+    the same scalar), and fed to one ``lax.psum_scatter`` — rank ``r``
+    receives the REDUCED slice ``flat[r*s:(r+1)*s]``. Returns the per-bucket
+    shard list (order = plan order).
+
+    ``return_finite=True`` additionally returns a **rank-local** all-finite
+    scalar derived from the already-reduced shards: IEEE sums propagate any
+    rank's NaN/Inf into the reduced value at that position, which lands on
+    exactly one rank's shard — so the flag differs per rank and only the
+    AND over ranks is the world-wide verdict. :func:`fused_allgather_params`
+    folds that AND into the all-gather the updated shards already ride
+    (``and_finite=``), keeping the bad-step guard at zero extra collectives
+    in ZeRO mode too.
+    """
+    leaves = plan.treedef.flatten_up_to(tree)
+    scale = None
+    if average and plan.nshards > 1:
+        scale = 1.0 / plan.nshards
+    if prescale is not None:
+        scale = prescale if scale is None else scale * prescale
+    shards = []
+    finite = jnp.ones((), jnp.bool_)
+    for i in range(len(plan.buckets)):
+        flat = _prescale_array(_fuse_bucket(leaves, plan, i), scale)
+        if plan.nshards > 1:
+            shard = jax.lax.psum_scatter(flat, axis_name, tiled=True)
+        else:
+            shard = flat  # single shard: the reduce is the identity
+        if return_finite and jnp.issubdtype(shard.dtype, jnp.inexact):
+            finite = finite & jnp.all(jnp.isfinite(shard))
+        shards.append(shard)
+    return (shards, finite) if return_finite else shards
+
+
+def shard_params(tree, plan: ZeroPlan, *, axis_name: str = AXIS,
+                 rank: Optional[int] = None):
+    """Slice this rank's flat bucket shards out of a replicated pytree
+    (no collective — each rank takes ``flat[rank*s:(rank+1)*s]``). The
+    owner index is ``lax.axis_index`` in-trace, or the static ``rank``
+    the env-world plane passes (one process = one shard)."""
+    leaves = plan.treedef.flatten_up_to(tree)
+    idx = jax.lax.axis_index(axis_name) if rank is None else rank
+    shards = []
+    for i in range(len(plan.buckets)):
+        flat = _fuse_bucket(leaves, plan, i)
+        s = plan.shard_len(i)
+        if plan.nshards == 1:
+            shards.append(flat)
+        elif rank is None:
+            shards.append(jax.lax.dynamic_slice(flat, (idx * s,), (s,)))
+        else:
+            shards.append(flat[rank * s:(rank + 1) * s])
+    return shards
+
+
+def _unfuse_flat(flats, plan: ZeroPlan):
+    """Rebuild the original tree from per-bucket UNPADDED flat vectors."""
+    reduced: List[Optional[jax.Array]] = [None] * len(plan.shapes)
+    for i, bucket in enumerate(plan.buckets):
+        flat = flats[i]
+        offset = 0
+        for j in bucket:
+            n = int(math.prod(plan.shapes[j]))
+            reduced[j] = jnp.reshape(flat[offset:offset + n], plan.shapes[j])
+            offset += n
+    return plan.treedef.unflatten(reduced)
+
+
+def fused_allgather_params(shards, plan: ZeroPlan, *,
+                           axis_name: str = AXIS,
+                           and_finite: Optional[jax.Array] = None):
+    """Rebuild a full pytree from every rank's updated flat bucket shards:
+    one ``all_gather`` per bucket, padding stripped, leaves reshaped.
+
+    ``and_finite`` (a rank-LOCAL boolean from
+    :func:`fused_reduce_scatter`'s ``return_finite``) rides the same
+    gather: the scalar is appended as one extra element to the first
+    inexact bucket's shard, so after gathering every rank sees every
+    rank's flag and the AND is replica-identical — the world-wide
+    bad-step verdict with **zero** extra collectives. Returns
+    ``(tree, all_finite)`` in that case, else just ``tree``.
+    """
+    nb = len(plan.buckets)
+    flag_bucket = None
+    if and_finite is not None:
+        flag_bucket = next(
+            (i for i in range(nb)
+             if jnp.issubdtype(jnp.dtype(plan.dtypes[plan.buckets[i][0]]),
+                               jnp.inexact)), None)
+    shards = list(shards)
+    if flag_bucket is not None:
+        flag = and_finite.astype(shards[flag_bucket].dtype).reshape(1)
+        shards[flag_bucket] = jnp.concatenate([shards[flag_bucket], flag])
+    flats = []
+    all_finite = None
+    for i in range(nb):
+        if plan.nshards > 1:
+            gathered = all_gather_invariant(shards[i], axis_name, tiled=True)
+        else:
+            gathered = shards[i]
+        if i == flag_bucket:
+            s = plan.shard_len(i)
+            blocks = gathered.reshape(plan.nshards, s + 1)
+            # 1.0/0.0 flags by construction (isfinite output cast to the
+            # bucket dtype) — exactly representable in every float dtype.
+            all_finite = jnp.all(blocks[:, -1].astype(jnp.float32) > 0.5)
+            gathered = blocks[:, :s].reshape(-1)
+        flats.append(gathered[:plan.sizes[i]])
+    out = _unfuse_flat(flats, plan)
+    if and_finite is None:
+        return out
+    if all_finite is None:
+        # No inexact bucket: an all-integer tree is finite by construction,
+        # so the local flag (constant True) is already the global verdict.
+        all_finite = and_finite
+    return out, all_finite

@@ -33,17 +33,20 @@ checkpoints restore with plain optax, without this framework installed.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import dataclasses
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from . import runtime
 from .ops.collectives import broadcast as _broadcast
-from .ops.fusion import fused_allreduce
-from .ops.sparse import IndexedSlices, allreduce_indexed_slices
+from .ops.fusion import (ZeroPlan, fused_allgather_params, fused_allreduce,
+                         fused_reduce_scatter, plan_zero, shard_params)
 from .runtime import AXIS
+from .ops.sparse import IndexedSlices, allreduce_indexed_slices
 
 
 def _is_sparse_leaf(x) -> bool:
@@ -84,6 +87,277 @@ class Compression:
             return t.astype(ctx) if ctx is not None else t
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded optimizer (Rajbhandari et al. 2020 stage 1; Xu et al. 2020's
+# weight-update sharding): every rank holds 1/N of the optimizer state, the
+# gradient exchange becomes reduce-scatter + all-gather over the same fused
+# buckets (same bytes on the wire as the all-reduce), and the optimizer math
+# runs on 1/N of the elements. See ops/fusion.py for the bucket plane and
+# docs/performance.md for when to flip it on.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ZeroShardedState:
+    """Rank-sharded optimizer state: the wrapped transformation's state over
+    this world's flat bucket shards, plus the static bucket layout.
+
+    ``inner`` is the wrapped optax state whose array leaves live in the
+    stacked-shard layout ``[nshards, shard_len]`` — the leading axis is
+    split one shard per rank over the world mesh (``P(AXIS)``), so each
+    device holds ``1/nshards`` of every optimizer-state array. In a tpurun
+    env-world each independent process holds only its OWN shard
+    (``[1, shard_len]`` locally). Scalar leaves (e.g. Adam's step count)
+    stay replicated. ``plan`` (static aux data) records the bucket layout
+    so update/checkpoint can rebuild full trees.
+    """
+
+    inner: Any
+    plan: ZeroPlan
+
+
+jax.tree_util.register_dataclass(
+    ZeroShardedState, data_fields=("inner",), meta_fields=("plan",))
+
+
+def _zero_shard_leaf_buckets(inner, plan: ZeroPlan) -> List[Optional[int]]:
+    """Map each flattened leaf of ``inner`` to the bucket whose stacked
+    shard array it mirrors, or None for non-shard leaves (scalars).
+
+    Elementwise optax transformations keep per-parameter state in subtrees
+    shaped exactly like the params they were initialized on — here, the
+    tuple of stacked ``(nshards, shard_len_i)`` bucket arrays — and those
+    subtrees flatten contiguously in bucket order. Two buckets can share a
+    stacked shape while differing in true (unpadded) length, so shape
+    alone cannot identify a bucket; position within a contiguous run can,
+    and is what checkpoint canonicalization needs to strip each bucket's
+    padding correctly (:func:`zero_to_canonical`).
+    """
+    shard_shapes = plan.shard_shapes()
+    nb = len(shard_shapes)
+    out: List[Optional[int]] = []
+    run = 0  # next bucket index expected in the current params-shaped run
+    for leaf in jax.tree_util.tree_leaves(inner):
+        shape = tuple(np.shape(leaf))
+        if nb and shape == shard_shapes[run]:
+            out.append(run)
+            run = (run + 1) % nb
+        elif nb and shape == shard_shapes[0]:
+            out.append(0)
+            run = 1 % nb
+        else:
+            out.append(None)
+            run = 0
+    return out
+
+
+def zero_to_canonical(state: ZeroShardedState, *,
+                      placeholders: bool = False) -> ZeroShardedState:
+    """World-agnostic checkpoint form of a ZeRO state: every stacked
+    ``[nshards, shard_len]`` shard leaf becomes the flat UNPADDED
+    ``[true_len]`` vector, which is identical regardless of the world size
+    that wrote it — so a checkpoint saved at world N restores (re-sharded)
+    at world M. Scalar leaves pass through. ``placeholders=True`` emits
+    ``np.zeros`` stand-ins (for building orbax restore templates without
+    touching device data). No-op for env-world local-shard states (their
+    leaves are ``[1, shard_len]`` with ``nshards > 1`` — only this rank's
+    slice exists locally, so there is nothing world-agnostic to write)."""
+    plan = state.plan
+    ids = _zero_shard_leaf_buckets(state.inner, plan)
+    leaves, treedef = jax.tree_util.tree_flatten(state.inner)
+    out = []
+    for leaf, b in zip(leaves, ids):
+        if b is None:
+            out.append(leaf)
+        elif placeholders:
+            out.append(np.zeros((plan.sizes[b],),
+                                np.dtype(plan.dtypes[plan.buckets[b][0]])))
+        else:
+            out.append(jnp.reshape(leaf, (-1,))[:plan.sizes[b]])
+    return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
+
+
+def zero_from_canonical(canonical: Any,
+                        template: ZeroShardedState) -> ZeroShardedState:
+    """Re-shard a canonical (flat, unpadded) ZeRO state onto ``template``'s
+    world: each flat leaf is zero-padded to the template plan's padded
+    length, stacked ``[nshards, shard_len]``, and placed with the template
+    leaf's sharding when it has one (the live state's ``P(AXIS)`` layout).
+    ``canonical`` may be the structurally-restored orbax tree (containers
+    as dicts/lists) — leaves are paired positionally with the template's.
+    """
+    plan = template.plan
+    ids = _zero_shard_leaf_buckets(template.inner, plan)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template.inner)
+    c_leaves = jax.tree_util.tree_leaves(canonical)
+    if len(c_leaves) != len(t_leaves):
+        raise ValueError(
+            f"ZeRO state mismatch: checkpoint has {len(c_leaves)} "
+            f"optimizer-state leaves, this world's template has "
+            f"{len(t_leaves)} — was the checkpoint written by a different "
+            f"optimizer?")
+    out = []
+    for c, t, b in zip(c_leaves, t_leaves, ids):
+        if b is None:
+            out.append(c)
+            continue
+        flat = np.asarray(c).reshape(-1)
+        if flat.size != plan.sizes[b]:
+            raise ValueError(
+                f"ZeRO shard length mismatch: checkpoint leaf has "
+                f"{flat.size} elements, this world's bucket {b} expects "
+                f"{plan.sizes[b]} — the fusion bucket plan differs "
+                f"(HOROVOD_FUSION_THRESHOLD must match the saving run, "
+                f"and the model must be unchanged)")
+        pad = plan.padded[b] - plan.sizes[b]
+        if pad:
+            flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+        stacked = flat.reshape(plan.nshards, plan.shard_len(b))
+        if isinstance(t, jax.Array):
+            stacked = jax.device_put(stacked, t.sharding)
+        out.append(stacked)
+    return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
+
+
+def partition_optimizer(optimizer: optax.GradientTransformation,
+                        *,
+                        average: bool = True,
+                        fusion_threshold: Optional[int] = None,
+                        accum_steps: int = 1,
+                        axis_name: str = AXIS
+                        ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with ZeRO-1 sharded updates.
+
+    ``init_fn`` materializes only this rank's optimizer-state shard
+    (``1/size()`` of the bytes per device — single-controller worlds place
+    the stacked shards ``P(AXIS)`` over the mesh, env-world processes
+    build just their own slice). ``update_fn`` reduce-scatters the
+    gradient tree over the fused buckets
+    (:func:`~horovod_tpu.ops.fusion.fused_reduce_scatter`), runs the
+    wrapped transformation on the local flat shards, and all-gathers the
+    updated shards back into a full update tree — so
+    ``optax.apply_updates(params, updates)`` keeps its contract and every
+    replica ends bit-identical.
+
+    Constraints (raised eagerly): dense gradients only (no
+    ``IndexedSlices`` leaves — densify upstream), and the wrapped
+    transformation must be ELEMENTWISE over its parameters (sgd, momentum,
+    adam, adamw, ... — anything whose update of element ``i`` depends only
+    on element ``i``'s gradient/state/param): the optimizer math sees flat
+    bucket shards, not the original tree, so per-layer logic (multi-
+    transform masks keyed on the tree, global-norm clipping) would compute
+    per-SHARD instead. ``update`` must run inside the compiled step
+    (``make_train_step(zero=True)``) when the world is larger than one.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    prescale = None if accum_steps <= 1 else 1.0 / accum_steps
+
+    def _nshards() -> int:
+        return runtime.size() if runtime.is_initialized() else 1
+
+    def init_fn(params):
+        n = _nshards()
+        plan = plan_zero(params, n, fusion_threshold)
+        env_world = runtime.is_initialized() and runtime.world().env_world
+        rank = runtime.world().controller_rank if env_world else None
+        leaves = plan.treedef.flatten_up_to(params)
+        from .ops.fusion import _fuse_bucket
+        stacked = []
+        for i in range(len(plan.buckets)):
+            flat = _fuse_bucket(leaves, plan, i)
+            s = plan.shard_len(i)
+            if env_world:
+                # One independent process per rank: materialize ONLY this
+                # rank's slice — true 1/N host+device memory.
+                arr = flat[rank * s:(rank + 1) * s].reshape(1, s)
+            else:
+                arr = jnp.reshape(flat, (n, s))
+                if (runtime.is_initialized() and n > 1
+                        and not isinstance(arr, jax.core.Tracer)):
+                    # Place the stacked shards split over the world mesh
+                    # up front: each device holds 1/N of every
+                    # optimizer-state array from step 0.
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+                    arr = jax.device_put(
+                        arr, NamedSharding(runtime.mesh(), P(axis_name)))
+            stacked.append(arr)
+        inner = optimizer.init(tuple(stacked))
+        if (not env_world and runtime.is_initialized() and n > 1):
+            # Shard leaves inherited the stacked arrays' P(AXIS) layout
+            # through the inner init's zeros_like; commit the scalar
+            # leaves (e.g. Adam's count) to the same mesh replicated, so
+            # the whole state shares one device set — required both for
+            # jit dispatch and for these trees to serve as restore
+            # templates (restore_sharded places leaves from the
+            # template's sharding).
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            shard_shapes = set(plan.shard_shapes())
+            rep = NamedSharding(runtime.mesh(), P())
+            inner = jax.tree_util.tree_map(
+                lambda l: l if (isinstance(l, jax.core.Tracer)
+                                or tuple(np.shape(l)) in shard_shapes)
+                else jax.device_put(l, rep), inner)
+        return ZeroShardedState(inner=inner, plan=plan)
+
+    def update_fn(grads, state: ZeroShardedState, params=None, **extra):
+        if params is None:
+            raise ValueError(
+                "ZeRO update requires params: each rank slices its flat "
+                "parameter shard locally for the wrapped optimizer "
+                "(weight decay etc.) — call update(grads, state, params)")
+        finite_out = extra.pop("finite_out", None)
+        plan = state.plan
+        if plan.nshards > 1 and not runtime._in_world_trace():
+            raise ValueError(
+                "ZeRO updates must run inside the compiled step (the "
+                "reduce-scatter/all-gather pair is an in-trace collective "
+                "over the world axis) — build the step with "
+                "make_train_step(zero=True), or use the env-world plane "
+                "which drives the exchange from the host")
+        if runtime.is_initialized() and runtime._in_world_trace():
+            from .utils.compat import axis_size
+            world = int(axis_size(axis_name))
+            if world != plan.nshards:
+                raise ValueError(
+                    f"optimizer state was partitioned for a world of "
+                    f"{plan.nshards} but this step runs over {world} "
+                    f"rank(s) — initialize the state after hvd.init() "
+                    f"(or rebuild it for the current world)")
+        need_finite = finite_out is not None
+        out = fused_reduce_scatter(
+            grads, plan, average=average, axis_name=axis_name,
+            prescale=prescale, return_finite=need_finite)
+        grad_shards, local_finite = out if need_finite else (out, None)
+        p_shards = shard_params(params, plan, axis_name=axis_name)
+        # The inner state's array leaves are per-device [1, shard_len]
+        # blocks of the stacked layout; present the flat shards the same
+        # way so elementwise state updates broadcast shape-exactly.
+        gs = tuple(g.reshape(1, -1) for g in grad_shards)
+        ps = tuple(p.reshape(1, -1) for p in p_shards)
+        upd_shards, new_inner = optimizer.update(gs, state.inner, ps)
+        flat_upd = [u.reshape(-1) for u in upd_shards]
+        gathered = fused_allgather_params(
+            flat_upd, plan, axis_name=axis_name,
+            and_finite=local_finite if need_finite else None)
+        if need_finite:
+            updates, all_finite = gathered
+            finite_out["all_finite"] = all_finite
+        else:
+            updates = gathered
+        return updates, ZeroShardedState(inner=new_inner, plan=plan)
+
+    update_fn.accum_steps = accum_steps
+    update_fn.supports_finite_out = True
+    update_fn.zero = True
+    # The env-world plane drives the collectives from the host and needs
+    # direct access to the wrapped transformation's shard update.
+    update_fn.inner_update = optimizer.update
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *,
                          average: bool = True,
@@ -91,6 +365,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          sparse_as_dense: bool = False,
                          compression: Any = Compression.none,
                          accum_steps: int = 1,
+                         zero: bool = False,
                          axis_name: str = AXIS
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with fused gradient allreduce.
@@ -112,9 +387,50 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     ``make_train_step(accum_steps=N)`` which scans microbatches inside the
     compiled step and performs the microbatch mean itself (do NOT set both:
     the gradients would be divided by N twice).
+
+    ``zero=True`` switches to ZeRO-1 sharded updates
+    (:func:`partition_optimizer`): the fused all-reduce becomes a fused
+    reduce-scatter + all-gather over the SAME buckets (same bytes on the
+    wire), each rank holds and updates ``1/size()`` of the optimizer state,
+    and the returned state is a :class:`ZeroShardedState`. Build the step
+    with ``make_train_step(zero=True)`` (or ``HVD_ZERO=1``). Composes with
+    ``accum_steps`` and the bad-step guard; ``compression`` does not (the
+    scatter's accumulation dtype is the gradient dtype — raise an issue
+    before casting blindly) and sparse gradients must be densified
+    (``sparse_as_dense=True``).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    if zero:
+        if compression is not Compression.none:
+            raise ValueError(
+                "zero=True does not compose with gradient compression: "
+                "the reduce-scatter's accumulation dtype is the wire "
+                "dtype, so a bf16-compressed scatter would lose the f32 "
+                "accumulation the fused all-reduce path keeps — use one "
+                "or the other")
+        part = partition_optimizer(
+            optimizer, average=average, fusion_threshold=fusion_threshold,
+            accum_steps=accum_steps, axis_name=axis_name)
+        if not sparse_as_dense:
+            return part
+
+        def _densify(grads):
+            return jax.tree_util.tree_map(
+                lambda l: l.to_dense() if _is_sparse_leaf(l) else l,
+                grads, is_leaf=_is_sparse_leaf)
+
+        def zero_update(grads, state, params=None, **extra):
+            return part.update(_densify(grads), state, params, **extra)
+
+        for attr in ("accum_steps", "supports_finite_out", "zero",
+                     "inner_update"):
+            setattr(zero_update, attr, getattr(part.update, attr))
+        # The env-world plane flattens grads itself (it never enters this
+        # wrapper) and consults the stamp to densify before bucketing.
+        zero_update.sparse_as_dense = True
+        return optax.GradientTransformation(part.init, zero_update)
 
     def init_fn(params):
         return optimizer.init(params)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import zlib
 from typing import Any, List, Optional, Tuple
 
@@ -230,6 +231,69 @@ def verify_checkpoint(path: str, *, allow_unverified: bool = True) -> bool:
     return True
 
 
+# ---------------------------------------------------------------------------
+# ZeRO (rank-sharded) optimizer state: checkpoints store the WORLD-AGNOSTIC
+# canonical form — each stacked [nshards, shard_len] shard array becomes the
+# flat unpadded vector it encodes, identical no matter how many ranks wrote
+# it — so an elastic restart may restore at a different world size and the
+# restore re-shards onto the new world's layout (docs/checkpointing.md).
+# ---------------------------------------------------------------------------
+
+
+def _is_zero_state(x) -> bool:
+    from ..optimizer import ZeroShardedState
+    return isinstance(x, ZeroShardedState)
+
+
+def _has_zero_state(tree: Any) -> bool:
+    return any(_is_zero_state(l) for l in jax.tree_util.tree_leaves(
+        tree, is_leaf=_is_zero_state))
+
+
+def _zero_stays_sharded(x) -> bool:
+    """A ZeRO node whose stacked arrays are not fully addressable (a
+    jax.distributed world where other processes own part of them) cannot
+    be canonicalized on this host — it is written AND restored in the
+    sharded layout (orbax handles both collectively), and such
+    checkpoints restore at the same world size only. Save and restore
+    must take the same branch, so both consult this predicate."""
+    return any(isinstance(l, jax.Array) and not l.is_fully_addressable
+               for l in jax.tree_util.tree_leaves(x.inner))
+
+
+def _canonicalize_zero(tree: Any, placeholders: bool = False) -> Any:
+    """Replace every :class:`~horovod_tpu.optimizer.ZeroShardedState` node
+    with its canonical (flat, unpadded, world-agnostic) form. Nodes kept
+    sharded by :func:`_zero_stays_sharded` pass through unchanged — also
+    when building restore templates (``placeholders=True``), since the
+    checkpoint's bytes are then in the sharded layout too. No-op for
+    trees without ZeRO state."""
+    from ..optimizer import zero_to_canonical
+
+    def _one(x):
+        if not _is_zero_state(x) or _zero_stays_sharded(x):
+            return x
+        return zero_to_canonical(x, placeholders=placeholders)
+
+    return jax.tree_util.tree_map(_one, tree, is_leaf=_is_zero_state)
+
+
+def _restore_zero(template_tree: Any, restored_tree: Any) -> Any:
+    """Re-shard canonically-restored ZeRO nodes onto ``template_tree``'s
+    world layout (stacking + padding + the template leaves' shardings);
+    nodes restored in the sharded layout (:func:`_zero_stays_sharded`)
+    and all other restored leaves pass through untouched."""
+    from ..optimizer import zero_from_canonical
+
+    def _one(t, r):
+        if _is_zero_state(t) and not _zero_stays_sharded(t):
+            return zero_from_canonical(r.inner, t)
+        return r
+
+    return jax.tree_util.tree_map(_one, template_tree, restored_tree,
+                                  is_leaf=_is_zero_state)
+
+
 def snapshot_to_host(tree: Any, timeline: Any = None) -> Any:
     """The snapshot half of an async checkpoint (``CKPT_SNAPSHOT`` timeline
     phase): one bulk device→host fetch of a pytree into numpy.
@@ -256,10 +320,16 @@ def save_sharded(directory: str, step: int, params: Any,
     per-leaf integrity manifest (:func:`write_manifest`) into the
     checkpoint directory — strictly before any elastic commit marker, so
     a marker-bearing step is always verifiable.
+
+    ZeRO optimizer state is written in its canonical world-agnostic form
+    (:func:`_canonicalize_zero`: flat unpadded bucket vectors), so the
+    manifest CRCs — and therefore :func:`verify_checkpoint` and the
+    elastic fallback walk — hold across world-size changes, and
+    :func:`restore_sharded` can re-shard onto a different world.
     """
     import orbax.checkpoint as ocp
     path = _ckpt_path(directory, step)
-    tree = {"params": params, "opt_state": opt_state}
+    tree = _canonicalize_zero({"params": params, "opt_state": opt_state})
     if all(not isinstance(l, jax.Array) or l.is_fully_addressable
            for l in jax.tree_util.tree_leaves(tree)):
         # One bulk device→host fetch feeds BOTH the orbax write and the
@@ -305,6 +375,13 @@ def restore_sharded(directory: str, params_template: Any,
     raises :class:`~horovod_tpu.exceptions.CheckpointCorruptError` on a
     mismatch instead of silently resuming from garbage; pass False when
     the caller already verified this step (the elastic fallback walk).
+
+    ZeRO optimizer state restores through its canonical world-agnostic
+    form and is RE-SHARDED onto the template's world: a checkpoint
+    committed by an 8-rank run restores into a 4-rank (or 16-rank)
+    world's :class:`~horovod_tpu.optimizer.ZeroShardedState` templates,
+    provided the model and ``HOROVOD_FUSION_THRESHOLD`` (the bucket
+    plan) are unchanged.
     """
     import orbax.checkpoint as ocp
     if step is None:
@@ -318,6 +395,17 @@ def restore_sharded(directory: str, params_template: Any,
     if verify:
         verify_checkpoint(path)
     template = {"params": params_template, "opt_state": opt_state_template}
+    # ZeRO nodes restore via np placeholders in the canonical layout (the
+    # checkpoint's format); everything else keeps the template leaf and
+    # its sharding.
+    canon_template = _canonicalize_zero(template, placeholders=True)
+    if _has_zero_state(template) and runtime.is_initialized():
+        manifest = read_manifest(path)
+        saved_world = manifest.get("world_size") if manifest else None
+        if saved_world is not None and saved_world != runtime.size():
+            print(f"[ckpt] re-sharding ZeRO optimizer state: checkpoint "
+                  f"written by a world of {saved_world}, restoring into "
+                  f"{runtime.size()}", file=sys.stderr, flush=True)
 
     def _restore_args(x):
         if isinstance(x, jax.Array) or isinstance(x, jax.ShapeDtypeStruct):
@@ -328,8 +416,9 @@ def restore_sharded(directory: str, params_template: Any,
 
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(
-        path, item=template,
-        restore_args=jax.tree_util.tree_map(_restore_args, template))
+        path, item=canon_template,
+        restore_args=jax.tree_util.tree_map(_restore_args, canon_template))
+    restored = _restore_zero(template, restored)
     return restored["params"], restored["opt_state"], int(step)
 
 

@@ -228,6 +228,7 @@ def create_train_state(model, rng, sample_input, optimizer,
                        *, average: bool = True,
                        fusion_threshold: Optional[int] = None,
                        compression: Any = Compression.none,
+                       zero: Optional[bool] = None,
                        has_batch_stats: Optional[bool] = None,
                        model_kwargs: Optional[dict] = None) -> Tuple[
                            TrainState, optax.GradientTransformation]:
@@ -238,7 +239,16 @@ def create_train_state(model, rng, sample_input, optimizer,
     bit-identical to plain optax state so checkpoints restore without this
     framework (the Keras dynamic-subclass parity property,
     ``horovod/keras/__init__.py:81-87``).
+
+    ``zero`` (default: ``HVD_ZERO``) wraps the optimizer with ZeRO-1
+    sharded updates instead (``DistributedOptimizer(zero=True)``): the
+    optimizer state is rank-sharded (1/size() per device) and the step
+    must be built with ``make_train_step(zero=True)`` — which it picks up
+    automatically from the optimizer's capability stamp.
     """
+    from .utils import config as _config
+    if zero is None:
+        zero = _config.zero_enabled()
     variables = model.init(rng, sample_input, **(model_kwargs or {}))
     params = variables.get("params", variables)
     batch_stats = variables.get("batch_stats")
@@ -246,7 +256,19 @@ def create_train_state(model, rng, sample_input, optimizer,
         batch_stats = None
     dist_opt = DistributedOptimizer(
         optimizer, average=average, fusion_threshold=fusion_threshold,
-        compression=compression)
+        compression=compression, zero=zero)
+    if (zero and runtime.is_initialized() and runtime.size() > 1
+            and not runtime.world().env_world):
+        # The ZeRO opt state is committed to the world mesh (stacked
+        # shards, P(AXIS)); commit the replicated half to the same mesh so
+        # the state is device-consistent from step 0 — and so these trees
+        # work as restore TEMPLATES (restore_sharded lays leaves out from
+        # the template's sharding, and a mixed dev0/mesh commitment would
+        # be rejected by jit).
+        rep = runtime.replicated_sharding()
+        params = jax.device_put(params, rep)
+        if batch_stats is not None:
+            batch_stats = jax.device_put(batch_stats, rep)
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -267,7 +289,8 @@ def make_train_step(model,
                     accum_steps: int = 1,
                     accum_unroll: Optional[int] = None,
                     remat: Any = False,
-                    guard_nonfinite: Optional[bool] = None):
+                    guard_nonfinite: Optional[bool] = None,
+                    zero: Optional[bool] = None):
     """Build the compiled SPMD train step.
 
     The returned function has signature ``step(state, batch) -> (state,
@@ -304,9 +327,37 @@ def make_train_step(model,
     other metric values are zeroed on skipped steps so a NaN loss cannot
     poison the epoch mean; ``Trainer.fit`` turns consecutive skips into
     rollback/abort containment (``HVD_MAX_BAD_STEPS``).
+
+    ``zero`` (default: ``HVD_ZERO``, or auto-detected from a
+    ``DistributedOptimizer(zero=True)`` optimizer) runs the ZeRO-1
+    sharded-update plane: the gradient exchange is one fused
+    reduce-scatter + one all-gather per bucket (no full-tree all-reduce),
+    the optimizer state rides the step rank-sharded (``P(AXIS)`` stacked
+    shards — 1/size() of the bytes per device), and every replica's
+    params stay bit-identical. Composes with ``accum_steps`` (the scatter
+    still fires once per accumulated step), ``remat``, and
+    ``guard_nonfinite`` (the world-wide all-finite flag rides the
+    all-gather the updated shards already take — zero extra collectives —
+    and a skip leaves the SHARDED opt state bit-unchanged).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    zero_stamped = getattr(dist_opt.update, "zero", False)
+    if zero is None:
+        from .utils import config as _config
+        zero = zero_stamped or _config.zero_enabled()
+    if zero and not zero_stamped:
+        raise ValueError(
+            "zero=True (or HVD_ZERO=1) requires a ZeRO-sharded optimizer: "
+            "the step's opt-state sharding specs come from its "
+            "partitioned state — build it with "
+            "DistributedOptimizer(opt, zero=True) / partition_optimizer "
+            "(create_train_state(zero=True) does this for you)")
+    if zero_stamped and not zero:
+        raise ValueError(
+            "this DistributedOptimizer was built with zero=True — its "
+            "state is rank-sharded and the step must be built with "
+            "make_train_step(zero=True) (leave zero unset to auto-detect)")
     if guard_nonfinite is None:
         from .utils import config as _config
         guard_nonfinite = _config.guard_nonfinite()
@@ -411,9 +462,47 @@ def make_train_step(model,
                                     axis_name, metrics_fn,
                                     accum_steps=accum_steps,
                                     accum_unroll=accum_unroll, remat=remat,
-                                    guard_nonfinite=guard_nonfinite)
+                                    guard_nonfinite=guard_nonfinite,
+                                    zero=zero)
 
     n_shards = int(mesh.shape[axis_name]) if accum_steps > 1 else 1
+
+    if zero:
+        # ZeRO plane: the optimizer state rides the step rank-sharded —
+        # its stacked [size, shard] leaves get P(axis) in/out specs so
+        # each device holds (and the donate reuses) 1/size of the bytes.
+        # The spec tree depends on the wrapped optimizer's state
+        # STRUCTURE, known only when the state first arrives; built once
+        # per structure and cached.
+        _zero_exec: dict = {}
+
+        def _zero_jitted(state: TrainState):
+            key = jax.tree_util.tree_structure(state.opt_state)
+            fn = _zero_exec.get(key)
+            if fn is None:
+                ospec = _zero_state_spec(state.opt_state, axis_name)
+                st_spec = TrainState(step=P(), params=P(),
+                                     opt_state=ospec, batch_stats=P())
+                fn = jax.jit(
+                    lambda s, x, y: jax.shard_map(
+                        _step, mesh=mesh,
+                        in_specs=(st_spec, P(axis_name), P(axis_name)),
+                        out_specs=(st_spec, P()),
+                        check_vma=False,
+                    )(s, x, y),
+                    donate_argnums=(0,) if donate else ())
+                _zero_exec[key] = fn
+            return fn
+
+        def step(state: TrainState, batch):
+            inputs, labels = batch
+            if accum_steps > 1:
+                _check_accum_batch(inputs, accum_steps, n_shards)
+            return _zero_jitted(state)(state, inputs, labels)
+
+        step.lower = lambda state, batch: \
+            _zero_jitted(state).lower(state, *batch)
+        return step
 
     @functools.wraps(jitted)
     def step(state: TrainState, batch):
@@ -428,6 +517,26 @@ def make_train_step(model,
     # accum_steps > 1 the count proves the psum sits outside the scan).
     step.lower = lambda state, batch: jitted.lower(state, *batch)
     return step
+
+
+def _zero_state_spec(opt_state, axis_name: str):
+    """PartitionSpec tree for a ZeRO optimizer state: ``P(axis)`` on the
+    stacked ``[nshards, shard_len]`` shard leaves (leading axis split one
+    shard per rank), ``P()`` on everything else (scalars like Adam's step
+    count stay replicated)."""
+    from .optimizer import ZeroShardedState
+
+    def _one(zs: ZeroShardedState):
+        shard_shapes = set(zs.plan.shard_shapes())
+        inner = jax.tree_util.tree_map(
+            lambda l: P(axis_name)
+            if tuple(getattr(l, "shape", ())) in shard_shapes else P(),
+            zs.inner)
+        return ZeroShardedState(inner=inner, plan=zs.plan)
+
+    return jax.tree_util.tree_map(
+        _one, opt_state,
+        is_leaf=lambda x: isinstance(x, ZeroShardedState))
 
 
 def _is_env_world(mesh) -> bool:
@@ -445,7 +554,8 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                          metrics_fn, accum_steps: int = 1,
                          accum_unroll: Optional[int] = None,
                          remat: Any = False,
-                         guard_nonfinite: bool = False):
+                         guard_nonfinite: bool = False,
+                         zero: bool = False):
     """Env-world train step: jit(grads) → host fused allreduce → jit(apply).
 
     The host gradient exchange uses the same fusion bucketing as the
@@ -462,6 +572,19 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
     the jitted apply half entirely on a bad step — params/opt_state stay
     the same arrays, the step counter advances, and ``bad_step`` rides
     the metrics dict exactly like the compiled plane.
+
+    ``zero`` routes the exchange through the coordinator's
+    ``reducescatter`` instead: each rank receives the reduced 1/size
+    slice of every fused bucket, updates its LOCAL optimizer-state shard
+    (this process physically holds only its own ``[1, shard_len]`` slice
+    — true 1/size host memory), and the updated shards ride one
+    ``allgather`` back into the full update tree. Same bytes on the wire
+    as the all-reduce (reduce-scatter + all-gather IS the ring
+    all-reduce), two host rounds instead of one. With the guard, each
+    rank's local finite verdict rides the update all-gather (one extra
+    ELEMENT, not an extra collective) so every rank takes the same skip
+    decision — a skipped step discards the speculative shard update and
+    keeps opt state bit-unchanged.
     """
     from .ops.fusion import plan_buckets
 
@@ -508,6 +631,11 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         _apply, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
         check_vma=False))
     counter = {"n": 0}
+
+    if zero:
+        return _make_env_world_zero_step(
+            dist_opt, grads_jit, counter, w,
+            accum_steps=accum_steps, guard_nonfinite=guard_nonfinite)
 
     def step(state: TrainState, batch):
         import numpy as np
@@ -577,6 +705,167 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
             return dataclasses.replace(state, step=state.step + 1), metrics
 
         state = apply_jit(state, grads, new_stats)
+        metrics = {k: w.coord.wait(h) for k, h in metric_handles.items()}
+        if guard_nonfinite:
+            metrics["bad_step"] = np.zeros((), np.float32)
+        return state, metrics
+
+    return step
+
+
+def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
+                              accum_steps: int,
+                              guard_nonfinite: bool):
+    """The ZeRO half of the env-world plane (see
+    :func:`_make_env_world_step`): coordinator reduce-scatter → jitted
+    local-shard optimizer update → coordinator all-gather of the updated
+    shards (+ the guard's finite flag) → jitted apply."""
+    import numpy as np
+
+    from .ops.collectives import Op
+    from .optimizer import ZeroShardedState
+
+    @jax.jit
+    def zero_update_jit(state: TrainState, grad_shards):
+        # plan is the state's static aux data — a trace-time constant.
+        from .ops.fusion import shard_params
+        plan = state.opt_state.plan
+        gs = tuple(g.reshape(1, -1) for g in grad_shards)
+        ps = tuple(p.reshape(1, -1) for p in shard_params(
+            state.params, plan, rank=w.controller_rank))
+        upd, new_inner = dist_opt.update.inner_update(
+            gs, state.opt_state.inner, ps)
+        return tuple(u.reshape(-1) for u in upd), new_inner
+
+    @jax.jit
+    def zero_apply_jit(state: TrainState, new_inner, updates, new_stats):
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(
+            step=state.step + 1, params=new_params,
+            opt_state=ZeroShardedState(inner=new_inner,
+                                       plan=state.opt_state.plan),
+            batch_stats=new_stats if new_stats is not None
+            else state.batch_stats)
+
+    def step(state: TrainState, batch):
+        from .ops.fusion import _unfuse_flat
+        from .optimizer import _is_sparse_leaf
+        inputs, labels = batch
+        if accum_steps > 1:
+            _check_accum_batch(inputs, accum_steps, 1)
+        loss, extras, new_stats, grads = grads_jit(state, inputs, labels)
+
+        if any(_is_sparse_leaf(l) for l in jax.tree_util.tree_leaves(
+                grads, is_leaf=_is_sparse_leaf)):
+            # This plane flattens grads itself (dist_opt.update's densify
+            # wrapper is bypassed), so honor the stamp here — or fail with
+            # the remedy named instead of a np.asarray TypeError below.
+            if not getattr(dist_opt.update, "sparse_as_dense", False):
+                raise ValueError(
+                    "ZeRO sharded updates require dense gradients: an "
+                    "IndexedSlices leaf cannot be flattened into "
+                    "rank-sharded buckets — build the optimizer with "
+                    "DistributedOptimizer(zero=True, sparse_as_dense="
+                    "True), or use the replicated optimizer for sparse "
+                    "models")
+            grads = jax.tree_util.tree_map(
+                lambda l: l.to_dense() if _is_sparse_leaf(l) else l,
+                grads, is_leaf=_is_sparse_leaf)
+
+        plan = state.opt_state.plan
+        if plan.nshards != w.size:
+            raise ValueError(
+                f"ZeRO optimizer state was partitioned for a world of "
+                f"{plan.nshards} but this env-world has {w.size} rank(s) "
+                f"— initialize the state after hvd.init() under the "
+                f"launcher (or restore through restore_sharded, which "
+                f"re-shards)")
+        leaves = plan.treedef.flatten_up_to(grads)
+        counter["n"] += 1
+        tag = counter["n"]
+        # User-driven accumulation (DistributedOptimizer(accum_steps=N)):
+        # fold the 1/N into the flat bucket before the scatter, exactly
+        # where the compiled plane's prescale sits.
+        pres = getattr(dist_opt.update, "accum_steps", 1)
+
+        handles = []
+        for bi, bucket in enumerate(plan.buckets):
+            if len(bucket) == 1:
+                flat = np.ravel(np.asarray(leaves[bucket[0]]))
+            else:
+                flat = np.concatenate(
+                    [np.ravel(np.asarray(leaves[j])) for j in bucket])
+            if pres > 1 and np.issubdtype(flat.dtype, np.inexact):
+                flat = flat * flat.dtype.type(1.0 / pres)
+            pad = plan.padded[bi] - plan.sizes[bi]
+            if pad:
+                flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+            handles.append(w.coord.submit(
+                "reducescatter", flat, f"zgrad.{tag}.{bi}",
+                op=Op.AVERAGE))
+        metric_handles = {"loss": w.coord.submit(
+            "allreduce", np.asarray(loss, np.float32),
+            f"metric.loss.{tag}", op=Op.AVERAGE)}
+        for k, v in extras.items():
+            metric_handles[k] = w.coord.submit(
+                "allreduce", np.asarray(v, np.float32),
+                f"metric.{k}.{tag}", op=Op.AVERAGE)
+
+        shards = [np.asarray(w.coord.wait(h)) for h in handles]
+        local_finite = True
+        if guard_nonfinite:
+            # Mirrors the compiled plane: the reduced shard carries every
+            # rank's NaN/Inf for the slice THIS rank owns; the verdict
+            # for the whole tree is the AND over ranks, which rides the
+            # update all-gather below.
+            for s in shards:
+                if np.issubdtype(s.dtype, np.inexact):
+                    local_finite = local_finite and \
+                        bool(np.all(np.isfinite(s)))
+
+        upd_shards, new_inner = zero_update_jit(
+            state, tuple(jnp.asarray(s) for s in shards))
+
+        flag_bucket = None
+        if guard_nonfinite:
+            flag_bucket = next(
+                (i for i in range(len(plan.buckets))
+                 if np.issubdtype(np.dtype(plan.dtypes[plan.buckets[i][0]]),
+                                  np.inexact)), None)
+        gather_handles = []
+        for bi in range(len(plan.buckets)):
+            payload = np.asarray(upd_shards[bi])
+            if bi == flag_bucket:
+                payload = np.concatenate(
+                    [payload, np.asarray([1.0 if local_finite else 0.0],
+                                         payload.dtype)])
+            gather_handles.append(w.coord.submit(
+                "allgather", payload, f"zupd.{tag}.{bi}"))
+
+        flats = []
+        all_finite = local_finite
+        for bi in range(len(plan.buckets)):
+            out = np.asarray(w.coord.wait(gather_handles[bi]))
+            if bi == flag_bucket:
+                s = plan.shard_len(bi)
+                blocks = out.reshape(w.size, s + 1)
+                all_finite = bool(np.all(
+                    blocks[:, -1].astype(np.float64) > 0.5))
+                out = blocks[:, :s].reshape(-1)
+            flats.append(out[:plan.sizes[bi]])
+
+        if guard_nonfinite and not all_finite:
+            # Skip-step: the speculative shard update is discarded (opt
+            # state stays the same arrays), the drained metrics keep the
+            # protocol balanced, only the step counter advances.
+            for h in metric_handles.values():
+                w.coord.wait(h)
+            metrics = {k: np.zeros((), np.float32) for k in metric_handles}
+            metrics["bad_step"] = np.ones((), np.float32)
+            return dataclasses.replace(state, step=state.step + 1), metrics
+
+        updates = _unfuse_flat([jnp.asarray(f) for f in flats], plan)
+        state = zero_apply_jit(state, new_inner, updates, new_stats)
         metrics = {k: w.coord.wait(h) for k, h in metric_handles.items()}
         if guard_nonfinite:
             metrics["bad_step"] = np.zeros((), np.float32)

@@ -74,6 +74,16 @@ def guard_nonfinite() -> bool:
         "1", "true", "yes", "on")
 
 
+def zero_enabled() -> bool:
+    """``HVD_ZERO`` — default for ZeRO-1 sharded optimizer updates
+    (``create_train_state(zero=...)`` / ``make_train_step(zero=...)``):
+    the gradient exchange becomes reduce-scatter + all-gather over the
+    fused buckets and each rank holds 1/size() of the optimizer state
+    (``docs/performance.md``). Off unless set to 1/true/yes/on."""
+    return os.environ.get("HVD_ZERO", "").lower() in (
+        "1", "true", "yes", "on")
+
+
 # Consecutive skipped (non-finite) steps tolerated before Trainer.fit
 # rolls back to the last verified checkpoint / raises NonFiniteGradError.
 DEFAULT_MAX_BAD_STEPS: int = 5

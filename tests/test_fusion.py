@@ -56,6 +56,43 @@ def test_env_default_is_64mib(monkeypatch):
     assert config.fusion_threshold_bytes() == 1024
 
 
+def test_plan_is_cached_per_shapes_dtypes_threshold():
+    """Repeated planning of the same (shapes, dtypes, threshold) is a
+    cache hit (ISSUE 5 satellite): the scan is pure in those inputs, so
+    re-traces and per-step eager calls stop re-walking the tree."""
+    from horovod_tpu.ops.fusion import _plan_cached
+    leaves = [_leaf(np.random.randint(5, 50)) for _ in range(6)]
+    first = plan_buckets(leaves, fusion_threshold=1 << 10)
+    before = _plan_cached.cache_info().hits
+    again = plan_buckets(leaves, fusion_threshold=1 << 10)
+    assert again == first
+    assert _plan_cached.cache_info().hits == before + 1
+    # A different threshold is a different plan, not a stale hit.
+    assert plan_buckets(leaves, fusion_threshold=0) == \
+        [[i] for i in range(len(leaves))]
+
+
+def test_cached_plan_is_copy_safe():
+    """Callers get fresh mutable lists — mutating a returned plan must
+    not poison the cache for the next caller."""
+    leaves = [_leaf(7), _leaf(9)]
+    plan = plan_buckets(leaves, fusion_threshold=1 << 20)
+    pristine = [list(b) for b in plan]
+    plan[0].append(999)
+    assert plan_buckets(leaves, fusion_threshold=1 << 20) == pristine
+
+
+def test_env_threshold_change_beats_the_cache(monkeypatch):
+    """The cache keys on the RESOLVED threshold: flipping
+    HOROVOD_FUSION_THRESHOLD between calls (no explicit argument) still
+    changes the plan."""
+    leaves = [_leaf(8), _leaf(8)]
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "0")
+    assert plan_buckets(leaves) == [[0], [1]]
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+    assert plan_buckets(leaves) == [[0, 1]]
+
+
 # ---------------------------------------------------------------------------
 # Compiled-artifact pinning: the plan must survive compilation.
 # ---------------------------------------------------------------------------
