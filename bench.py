@@ -175,9 +175,100 @@ def _build_model(cfg):
     return models.cifar_resnet_v1(20, dtype=jnp.float32)
 
 
-def measure(devices=None, cfg=None) -> float:
+def _measure_phases(model, dist_opt, cfg, state, data, accum, rate):
+    """Per-phase wall attribution (ISSUE 6 satellite): three compiled
+    probes over the same sharded batch — backward only; backward + the
+    gradient exchange (the same fused all-reduce or reduce-scatter/
+    all-gather round the step takes, same wire/overlap knobs); and the
+    full step (derived from the measured rate). The exchange's EXPOSED
+    wall time is ``t(exchange) - t(backward)``: when overlap hides the
+    collectives behind backward compute it collapses toward zero even
+    though the same bytes move — which is exactly what BENCH_r06 needs to
+    show, not just img/s. Single-controller only (the env-world exchange
+    is host-plane and already measured by its wait times)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops import fusion as _f
+
+    if hvd.world().env_world:
+        return None
+    mesh = hvd.mesh()
+    vag = training._build_value_and_grad(
+        model, training.cross_entropy_loss, False)
+    zero = bool(cfg.get("zero", False))
+    wire = cfg.get("wire_dtype")
+    overlap = bool(cfg.get("overlap", False))
+    rng0 = jax.random.PRNGKey(0)
+
+    def _grads(state, x, y):
+        if accum == 1:
+            _, g = vag(state.params, state.batch_stats, x, y, rng0)
+        else:
+            _, _, g, _ = training._accumulate_grads(
+                vag, state.params, state.batch_stats, x, y,
+                lambda i: jax.random.fold_in(rng0, i), accum, None)
+        return g
+
+    def _consume(tree):
+        # Sum every inexact leaf: keeps the whole backward (or exchange)
+        # live through DCE while returning one scalar to fetch.
+        tot = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                tot = tot + jnp.sum(leaf.astype(jnp.float32))
+        return jax.lax.pmean(tot, hvd.AXIS)
+
+    def _bwd_only(state, x, y):
+        return _consume(_grads(state, x, y))
+
+    def _bwd_exchange(state, x, y):
+        g = _grads(state, x, y)
+        if zero:
+            plan = state.opt_state.plan
+            emit = tuple(range(len(plan.buckets))) if overlap else None
+            shards = _f.fused_reduce_scatter(
+                g, plan, average=True, wire_dtype=wire, emit_order=emit)
+            return _consume(_f.fused_allgather_params(shards, plan))
+        return _consume(_f.fused_allreduce(
+            g, average=True, wire_dtype=wire, overlap=overlap))
+
+    def _sharded(fn):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P(), P(hvd.AXIS), P(hvd.AXIS)),
+            out_specs=P(), check_vma=False))
+
+    times = {}
+    for name, fn in (("backward", _sharded(_bwd_only)),
+                     ("exchange", _sharded(_bwd_exchange))):
+        fn(state, *data).block_until_ready()  # compile + warm
+        reps = []
+        for _ in range(max(3, int(cfg.get("iters", 3)))):
+            t0 = time.perf_counter()
+            fn(state, *data).block_until_ready()
+            reps.append(time.perf_counter() - t0)
+        times[name] = sorted(reps)[len(reps) // 2]
+
+    rows = jax.tree_util.tree_leaves(data)[0].shape[0]
+    t_step = rows / rate  # wall per optimizer step, from the headline rate
+    t_bwd = times["backward"]
+    t_coll = max(0.0, times["exchange"] - t_bwd)
+    t_upd = max(0.0, t_step - times["exchange"])
+    share = (lambda t: round(min(1.0, t / t_step), 3)) if t_step > 0 \
+        else (lambda t: 0.0)
+    return {
+        "backward_s": round(t_bwd, 6),
+        "collective_exposed_s": round(t_coll, 6),
+        "update_s": round(t_upd, 6),
+        "backward_share": share(t_bwd),
+        "collective_share": share(t_coll),
+        "update_share": share(t_upd),
+    }
+
+
+def measure(devices=None, cfg=None, want_phases: bool = False):
     """Images/sec of the compiled distributed train step over ``devices``
-    (default: all). Returns total (not per-chip) throughput."""
+    (default: all). Returns total (not per-chip) throughput — or
+    ``(rate, phases)`` with ``want_phases=True`` (phases is None on
+    env-world runs)."""
     cfg = cfg or _bench_config()
     if hvd.is_initialized():
         hvd.shutdown()
@@ -195,13 +286,16 @@ def measure(devices=None, cfg=None) -> float:
         model, jax.random.PRNGKey(0),
         jnp.zeros((cfg["batch_per_chip"],) + x_shape[1:], jnp.float32),
         optax.sgd(cfg.get("lr", 0.1), momentum=0.9),
-        zero=bool(cfg.get("zero", False)))
+        zero=bool(cfg.get("zero", False)),
+        wire_dtype=cfg.get("wire_dtype"))
     accum = int(cfg.get("accum_steps", 1))
     if cfg["batch_per_chip"] % accum:
         raise SystemExit(
             f"--accum-steps {accum} does not divide the per-chip batch "
             f"of {cfg['batch_per_chip']}")
-    step = training.make_train_step(model, dist_opt, accum_steps=accum)
+    step = training.make_train_step(
+        model, dist_opt, accum_steps=accum,
+        overlap=True if cfg.get("overlap") else None)
 
     # Materialize only local shards (a host-side global batch would be
     # multiple GB at pod scale).
@@ -228,7 +322,7 @@ def measure(devices=None, cfg=None) -> float:
 
         rate, _ = _median_rate(_region, state, batch * cfg["iters"],
                                int(cfg.get("rounds", 1)))
-        return rate
+        return (rate, None) if want_phases else rate
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     sharding = NamedSharding(hvd.mesh(), P(hvd.AXIS))
@@ -283,9 +377,14 @@ def measure(devices=None, cfg=None) -> float:
             s, loss = run_once(s)
         return s, loss
 
-    rate, _ = _median_rate(_region, state, batch * cfg["iters"] * k,
-                           int(cfg.get("rounds", 1)))
-    return rate
+    rate, state = _median_rate(_region, state, batch * cfg["iters"] * k,
+                               int(cfg.get("rounds", 1)))
+    if not want_phases:
+        return rate
+    # Per-step rate (rows of one optimizer step / wall), for the phase
+    # denominator — identical to `rate` since units_per_round counts rows.
+    phases = _measure_phases(model, dist_opt, cfg, state, data, accum, rate)
+    return rate, phases
 
 
 # ---------------------------------------------------------------------------
@@ -358,7 +457,8 @@ def measure_lm(cfg=None) -> float:
         unembed_dtype=jnp.bfloat16, remat=bool(cfg.get("remat", False)),
         loss_chunk=int(cfg.get("loss_chunk", 0)))
     opt = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    init_state, step = make_parallel_train_step(tcfg, mesh, opt)
+    init_state, step = make_parallel_train_step(
+        tcfg, mesh, opt, wire_dtype=cfg.get("wire_dtype"))
     params, opt_state = init_state(jax.random.PRNGKey(0))
 
     B = cfg["batch_per_chip"] * n
@@ -407,8 +507,11 @@ def measure_lm(cfg=None) -> float:
     return rate
 
 
-def lm_line() -> dict:
+def lm_line(wire_dtype=None) -> dict:
+    from horovod_tpu.ops.fusion import wire_dtype_name
     cfg = _lm_config()
+    if wire_dtype:
+        cfg["wire_dtype"] = wire_dtype
     rate = measure_lm(cfg)
     per_chip = rate / hvd.size()
     gflop_tok = lm_train_gflop_per_token(cfg)
@@ -422,6 +525,12 @@ def lm_line() -> dict:
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / baseline, 3),
         "tflops_per_chip": round(per_chip * gflop_tok / 1e3, 1),
+        # Knob provenance (ISSUE 6): overlap is a fused-bucket-plane knob —
+        # the GSPMD transformer has no explicit bucket collectives, so it
+        # is structurally off here; the wire knob applies to its dp-plane
+        # gradient averages.
+        "overlap": False,
+        "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
     }
     peak = _peak_tflops_per_chip()
     if peak:
@@ -458,6 +567,19 @@ def main() -> None:
                         "1/size per chip (docs/performance.md); recorded "
                         "in the JSON line alongside peak_bytes_per_chip "
                         "so the memory win is attributable")
+    p.add_argument("--overlap", action="store_true",
+                   help="backward-overlapped bucket collectives: per-"
+                        "bucket gradient collectives issue in backward-"
+                        "completion order behind optimization_barrier "
+                        "pins so wire time hides behind backward compute "
+                        "(docs/performance.md 'Overlap & wire formats'); "
+                        "recorded in every JSON line")
+    p.add_argument("--wire-dtype", default=None,
+                   choices=["fp32", "bf16", "fp8"],
+                   help="low-precision wire format for the gradient "
+                        "collectives (fp32 scales, fp32 result "
+                        "accumulation; fp8 is e4m3 with per-bucket "
+                        "dynamic scaling); recorded in every JSON line")
     args = p.parse_args()
     if args.accum_steps < 1:
         raise SystemExit(f"--accum-steps must be >= 1, got "
@@ -473,16 +595,25 @@ def main() -> None:
                 "--zero applies to the conv family (the "
                 "DistributedOptimizer path); the parallel transformer "
                 "shards its optimizer over the mesh already")
+        if args.overlap:
+            raise SystemExit(
+                "--overlap applies to the conv family (the fused-bucket "
+                "collective planes); the parallel transformer's "
+                "collectives are compiler-placed by GSPMD — a silent "
+                "ignore would mislabel the measurement")
         if args.scaling:
             raise SystemExit(
                 "--scaling is not supported for transformer_lm (the conv "
                 "family's re-init-with-device-subsets machinery does not "
                 "apply); run it without --scaling")
-        print(json.dumps(lm_line()))
+        print(json.dumps(lm_line(wire_dtype=args.wire_dtype)))
         return
     cfg = _bench_config(args.model or "resnet50")
     cfg["accum_steps"] = args.accum_steps
     cfg["zero"] = bool(args.zero)
+    cfg["overlap"] = bool(args.overlap)
+    if args.wire_dtype and args.wire_dtype != "fp32":
+        cfg["wire_dtype"] = args.wire_dtype
     if args.conv_backend:
         if (args.model or "resnet50") not in ("resnet50", "resnet101"):
             raise SystemExit(
@@ -495,6 +626,16 @@ def main() -> None:
                 "fallback config swaps the model to cifar20); run on TPU "
                 "without HVD_BENCH_SMOKE for a fused measurement")
         cfg["conv_backend"] = args.conv_backend
+
+    from horovod_tpu.ops.fusion import wire_dtype_name
+
+    def _knob_fields():
+        return {
+            "accum_steps": int(cfg.get("accum_steps", 1)),
+            "zero": bool(cfg.get("zero", False)),
+            "overlap": bool(cfg.get("overlap", False)),
+            "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
+        }
 
     if args.scaling:
         # Scaling mode is single-controller only: it re-inits the world with
@@ -525,6 +666,7 @@ def main() -> None:
                 "unit": "fraction",
                 "vs_baseline": round(eff / 0.90, 3),  # ref: 90% @ 128 GPUs
                 "images_per_sec_total": round(rate, 2),
+                **_knob_fields(),
             }))
         # Also emit the standard absolute metric (full world) so parsers
         # keyed on it always find it.
@@ -535,8 +677,7 @@ def main() -> None:
             "unit": "images/sec/chip",
             "vs_baseline": round(per_chip / _baseline_for(cfg["model"]),
                                  3),
-            "accum_steps": int(cfg.get("accum_steps", 1)),
-            "zero": bool(cfg.get("zero", False)),
+            **_knob_fields(),
         }
         peak_bytes = _peak_bytes_per_chip()
         if peak_bytes is not None:
@@ -544,16 +685,17 @@ def main() -> None:
         print(json.dumps(line))
         return
 
-    rate = measure(cfg=cfg)
+    rate, phases = measure(cfg=cfg, want_phases=True)
     per_chip = rate / hvd.size()
     line = {
         "metric": f"{cfg['model']}_synthetic_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / _baseline_for(cfg["model"]), 3),
-        "accum_steps": int(cfg.get("accum_steps", 1)),
-        "zero": bool(cfg.get("zero", False)),
+        **_knob_fields(),
     }
+    if phases is not None:
+        line["phases"] = phases
     peak_bytes = _peak_bytes_per_chip()
     if peak_bytes is not None:
         line["peak_bytes_per_chip"] = peak_bytes
@@ -571,7 +713,8 @@ def main() -> None:
             print("skipping transformer_lm line: single-controller only",
                   file=sys.stderr)
         else:
-            print(json.dumps(lm_line()), flush=True)
+            print(json.dumps(lm_line(wire_dtype=args.wire_dtype)),
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -302,6 +302,101 @@ print(f"zero smoke OK: parity over 3 steps, HLO rs/ag/ar={counts} for "
       f"{nb} bucket(s), world 8 -> 4 restore bit-exact and resumed")
 EOF
 
+echo "== overlap smoke: overlapped bf16-wire parity + HLO count/dtype pins (ISSUE 6) =="
+# ISSUE 6 acceptance: 3 steps with overlap=1 wire_dtype=bf16 must match the
+# non-overlapped fp32 run within wire tolerance on BOTH the fused-allreduce
+# and ZeRO planes, the bucket-collective count must be UNCHANGED by overlap
+# (it reorders, never adds), the emission must be barrier-chained in
+# backward-completion order, and the wire cast must be visible in HLO
+# (bf16 collective operands) without changing any count.
+run_cpu timeout -k 10 300 env HVD_OVERLAP=1 HVD_WIRE_DTYPE=bf16 python - <<'EOF'
+import os, re
+import flax.linen as nn
+import jax, jax.numpy as jnp, numpy as np, optax
+import horovod_tpu as hvd
+from horovod_tpu import training
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        h = x
+        for _ in range(3):
+            h = nn.relu(nn.Dense(64)(h))
+        return nn.Dense(10)(h)
+
+def build(zero, wire, overlap):
+    state, opt = training.create_train_state(
+        M(), jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.adam(1e-2),
+        zero=zero, wire_dtype=wire, overlap=overlap, fusion_threshold=8000)
+    return state, training.make_train_step(M(), opt, donate=False,
+                                           overlap=overlap)
+
+hvd.init()
+assert os.environ["HVD_OVERLAP"] == "1"  # env defaults are what ship
+rng = np.random.RandomState(0)
+batches = [(rng.randn(16, 8).astype(np.float32), rng.randint(0, 10, (16,)))
+           for _ in range(3)]
+for zero in (False, True):
+    # The reference pins wire_dtype="fp32" EXPLICITLY: with HVD_WIRE_DTYPE
+    # exported above, a None would resolve the env default and the
+    # "fp32 run" would silently ride bf16 too.
+    rs, rstep = build(zero, "fp32", False)
+    ws, wstep = build(zero, "bf16", True)
+    for b in batches:
+        rs, rm = rstep(rs, b)
+        ws, wm = wstep(ws, b)
+        np.testing.assert_allclose(float(wm["loss"]), float(rm["loss"]),
+                                   rtol=5e-3)
+    for a, b2 in zip(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, ws.params)),
+            jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, rs.params))):
+        np.testing.assert_allclose(a, b2, rtol=5e-2, atol=4e-2)
+    # Count pin: overlap reorders, never adds — same collective counts as
+    # the non-overlapped plan at the same threshold, wire on or off.
+    b = batches[0]
+    plain = rstep.lower(rs, b).as_text()
+    over = wstep.lower(ws, b).as_text()
+    for pat in (r"\ball_reduce\b", r"\breduce_scatter\b", r"\ball_gather\b"):
+        n_p, n_o = len(re.findall(pat, plain)), len(re.findall(pat, over))
+        assert n_p == n_o, (pat, n_p, n_o)
+    if zero:
+        nb = len(ws.opt_state.plan.buckets)
+        assert len(re.findall(r"\breduce_scatter\b", over)) == nb
+        # Wire pin: every scatter operand rides bf16; the update gather
+        # stays f32 (replicas end bit-identical).
+        scatters = re.findall(
+            r"stablehlo\.reduce_scatter(?:[^\n]*\n)+?\s*\}\) : \(tensor<([^>]+)>",
+            over)
+        assert scatters and all(t.endswith("xbf16") for t in scatters), scatters
+    else:
+        assert len(re.findall(r"optimization_barrier", over)) >= 1
+        assert "xbf16" in over  # cast-on-send reached the lowered module
+print("overlap smoke OK: bf16-wire overlap matches fp32 within tolerance "
+      "on both modes, collective counts unchanged, wire dtype pinned")
+EOF
+
+echo "== overlap smoke: env-world plane (tpurun, coordinator bf16 wire) =="
+timeout -k 10 300 python -m horovod_tpu.launcher -np 2 --cpu \
+  python tests/overlap_worker.py
+
+echo "== perf smoke: bench records overlap/wire knobs + per-phase attribution =="
+HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python bench.py --model resnet50 --overlap --wire-dtype bf16 \
+  | tee /tmp/bench_overlap.json
+python - <<'EOF'
+import json
+line = json.loads(open("/tmp/bench_overlap.json").read().strip().splitlines()[-1])
+assert line["value"] > 0, f"zero throughput: {line}"
+assert line["overlap"] is True, f"overlap knob not recorded: {line}"
+assert line["wire_dtype"] == "bf16", f"wire_dtype knob not recorded: {line}"
+phases = line.get("phases")
+assert phases and "collective_share" in phases and "backward_share" in phases, \
+    f"phase attribution block missing: {line}"
+print(f"bench overlap smoke OK: {line['value']} {line['unit']}, phases={phases}")
+EOF
+
 echo "== perf smoke: bench --zero records the knob + peak bytes =="
 HVD_BENCH_SMOKE=1 PYTHONPATH= JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -53,6 +53,12 @@ from .ops.collectives import (  # noqa: F401
     allgather_object,
 )
 from .ops.sparse import IndexedSlices  # noqa: F401
+from .ops.fusion import (  # noqa: F401
+    BucketSchedule,
+    plan_schedule,
+    probe_grad_order,
+    resolve_wire_dtype,
+)
 from .optimizer import (  # noqa: F401
     Compression,
     DistributedOptimizer,

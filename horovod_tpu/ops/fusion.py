@@ -41,25 +41,21 @@ import numpy as np
 
 from ..runtime import AXIS
 from ..utils import config as _config
-from ..utils.compat import all_gather_invariant
+from ..utils.compat import all_gather_invariant, axis_size
 from .collectives import Op, _reduce_in_trace
 
 
-@functools.lru_cache(maxsize=512)
-def _plan_cached(key: Tuple[Tuple[Tuple[int, ...], str], ...],
-                 fusion_threshold: int) -> Tuple[Tuple[int, ...], ...]:
-    """The fusion scan, memoized. The plan is a pure function of the leaf
-    (shape, dtype) sequence and the threshold, so repeated traces and
-    eager per-step calls over the same gradient tree (every step of the
-    env-world plane, every re-trace of the compiled one) stop re-walking
-    the whole tree. Keyed on resolved values only — the env-var default
-    is resolved by the caller, so changing ``HOROVOD_FUSION_THRESHOLD``
-    between calls still takes effect."""
+def _greedy_scan(key, order, fusion_threshold: int):
+    """The fusion scan over leaves visited in ``order``: fuse while the
+    dtype matches and cumulative bytes stay within the threshold; close the
+    bucket at the first non-fusable tensor (``mpi_ops.cc:1414-1419`` —
+    never look ahead, never reorder within the visit order)."""
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_dtype = None
     cur_bytes = 0
-    for i, (shape, dtype) in enumerate(key):
+    for i in order:
+        shape, dtype = key[i]
         nbytes = int(math.prod(shape)) * np.dtype(dtype).itemsize
         fusable = (
             fusion_threshold > 0
@@ -79,6 +75,19 @@ def _plan_cached(key: Tuple[Tuple[Tuple[int, ...], str], ...],
     if cur:
         buckets.append(cur)
     return tuple(tuple(b) for b in buckets)
+
+
+@functools.lru_cache(maxsize=512)
+def _plan_cached(key: Tuple[Tuple[Tuple[int, ...], str], ...],
+                 fusion_threshold: int) -> Tuple[Tuple[int, ...], ...]:
+    """The fusion scan, memoized. The plan is a pure function of the leaf
+    (shape, dtype) sequence and the threshold, so repeated traces and
+    eager per-step calls over the same gradient tree (every step of the
+    env-world plane, every re-trace of the compiled one) stop re-walking
+    the whole tree. Keyed on resolved values only — the env-var default
+    is resolved by the caller, so changing ``HOROVOD_FUSION_THRESHOLD``
+    between calls still takes effect."""
+    return _greedy_scan(key, range(len(key)), fusion_threshold)
 
 
 def plan_buckets(leaves: Sequence[jax.Array],
@@ -101,6 +110,141 @@ def plan_buckets(leaves: Sequence[jax.Array],
     return [list(b) for b in _plan_cached(key, int(fusion_threshold))]
 
 
+# ---------------------------------------------------------------------------
+# Backward-overlapped emission (ISSUE 6 tentpole; the core Horovod trick,
+# Sergeev & Del Balso 2018 §3): issue one collective per bucket AS ITS
+# GRADIENTS COMPLETE instead of one fused traversal after backward. On the
+# compiled plane the mechanism is data dependencies + optimization_barrier
+# pins: buckets group leaves ADJACENT IN BACKWARD-COMPLETION ORDER (so a
+# bucket's collective depends only on an early prefix of the backward), and
+# each bucket's operand is barrier-chained to the previous bucket's result —
+# which (a) fixes the issue order deterministically, (b) stops XLA's
+# all-reduce combiner from re-merging the buckets into one post-backward
+# blob, and (c) leaves XLA's latency-hiding scheduler free to hoist every
+# collective behind the remaining backward compute (it does: the HLO pin in
+# tests/test_overlap_wire.py shows each bucket's collective scheduled before
+# the last backward op of the module).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSchedule:
+    """An ordered fusion plan: ``buckets`` are leaf-index groups (over the
+    same flattened tree ``plan_buckets`` scans) built by walking the leaves
+    in ``order`` — backward-completion order from
+    :func:`probe_grad_order` — so bucket k's members finish together and
+    its collective can fire while buckets k+1... are still back-propagating.
+    A pure function of (shapes, dtypes, threshold, order): deterministic
+    across processes and across cache hits."""
+
+    buckets: Tuple[Tuple[int, ...], ...]
+    order: Tuple[int, ...]
+    threshold: int
+
+
+@functools.lru_cache(maxsize=512)
+def _schedule_cached(key, order, fusion_threshold: int):
+    return _greedy_scan(key, order, fusion_threshold)
+
+
+def plan_schedule(leaves: Sequence[jax.Array],
+                  grad_order: Optional[Sequence[int]] = None,
+                  fusion_threshold: Optional[int] = None) -> BucketSchedule:
+    """Build the overlap emission schedule for ``leaves``.
+
+    ``grad_order`` is the backward-completion permutation of leaf indices
+    (:func:`probe_grad_order`); None falls back to flatten order, which
+    degrades to the non-overlapped grouping. Same caching contract as
+    :func:`plan_buckets` — keyed on resolved (shapes, dtypes, order,
+    threshold), so an env-var threshold flip between calls still
+    invalidates."""
+    if fusion_threshold is None:
+        fusion_threshold = _config.fusion_threshold_bytes()
+    key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+                for leaf in leaves)
+    order = (tuple(range(len(key))) if grad_order is None
+             else tuple(int(i) for i in grad_order))
+    if sorted(order) != list(range(len(key))):
+        raise ValueError(
+            f"grad_order must be a permutation of the {len(key)} leaf "
+            f"indices; got {order}")
+    return BucketSchedule(
+        buckets=_schedule_cached(key, order, int(fusion_threshold)),
+        order=order, threshold=int(fusion_threshold))
+
+
+def probe_grad_order(grad_fn, *args, **kwargs) -> Optional[Tuple[int, ...]]:
+    """Backward-completion order of a gradient tree's leaves, from a
+    one-time abstract trace (no FLOPs): ``grad_fn(*args)`` must return the
+    grad tree; each output leaf is ranked by the position of its defining
+    equation in the traced jaxpr — the order the backward pass materializes
+    it. Leaves whose producer cannot be identified (literals, forwarded
+    inputs, leaves fused into one opaque sub-jaxpr such as a rolled scan)
+    keep flatten order as a stable tie-break, so the probe degrades to the
+    non-overlapped schedule rather than guessing. Returns None when the
+    function cannot be traced outside its collective context (e.g. a model
+    with cross-replica BatchNorm probed without its axis bound) — callers
+    fall back to flatten order."""
+    try:
+        closed = jax.make_jaxpr(grad_fn)(*args, **kwargs)
+    except Exception:  # noqa: BLE001 — probe is best-effort by contract
+        return None
+    jaxpr = closed.jaxpr
+    pos = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            pos[v] = i
+    outvars = jaxpr.outvars
+
+    def _rank(k):
+        v = outvars[k]
+        # Literal outvars (e.g. the zero cotangent of a leaf the loss never
+        # reads) are unhashable on older jax — they take the flatten-order
+        # fallback, same as any other unrankable leaf.
+        if not isinstance(v, jax.core.Var):
+            return (-1, k)
+        return (pos.get(v, -1), k)
+
+    return tuple(sorted(range(len(outvars)), key=_rank))
+
+
+@functools.lru_cache(maxsize=512)
+def _emit_order_cached(buckets, grad_order):
+    ready = []
+    pos = {leaf: p for p, leaf in enumerate(grad_order)}
+    for b in buckets:
+        ready.append(max(pos.get(j, j) for j in b))
+    return tuple(sorted(range(len(buckets)),
+                        key=lambda i: (ready[i], i)))
+
+
+def zero_emit_order(plan: "ZeroPlan",
+                    grad_order: Optional[Sequence[int]]) -> Tuple[int, ...]:
+    """Emission order of a :class:`ZeroPlan`'s buckets under overlap:
+    sorted by READINESS (the latest backward-completion position among the
+    bucket's members). Unlike the all-reduce plane's
+    :class:`BucketSchedule`, ZeRO bucket MEMBERSHIP never changes — the
+    plan defines the sharded optimizer-state layout and the world-agnostic
+    checkpoint form, so overlap may only reorder which bucket's
+    reduce-scatter issues first, never regroup leaves."""
+    if grad_order is None:
+        return tuple(range(len(plan.buckets)))
+    return _emit_order_cached(plan.buckets, tuple(int(i)
+                                                  for i in grad_order))
+
+
+def _barrier_chain(operand, prev):
+    """Pin emission order: barrier the next bucket's operand against the
+    previous bucket's reduced result. Creates the data dependency that (a)
+    makes the cross-bucket issue order deterministic and (b) keeps XLA's
+    collective combiner from merging the per-bucket collectives back into
+    one post-backward blob (combining requires independence)."""
+    if prev is None:
+        return operand
+    operand, _ = jax.lax.optimization_barrier((operand, prev))
+    return operand
+
+
 def _fuse(leaves: Sequence[jax.Array]) -> jax.Array:
     return jnp.concatenate([jnp.ravel(l) for l in leaves])
 
@@ -117,19 +261,140 @@ def _unfuse(flat: jax.Array, leaves: Sequence[jax.Array]) -> List[jax.Array]:
 
 def _prescale_array(x, prescale):
     """Scale one flat/bucketed array before its collective. Dtype-preserving
-    (the scale is cast to the operand dtype, so bf16 buckets stay bf16 on
-    the wire); integer leaves pass through untouched — a fractional scale
-    would silently floor them."""
+    on the outside (the result returns in the operand dtype, so bf16 buckets
+    stay bf16 on the wire), but sub-fp32 buckets are scaled IN fp32 — a
+    bf16 multiply quantizes the scale itself (bf16(1/3) carries 8 mantissa
+    bits) and double-rounds, so the fp32 product with a single final cast
+    is strictly more accurate for the same wire bytes. Integer leaves pass
+    through untouched — a fractional scale would silently floor them."""
     if prescale is None or not jnp.issubdtype(x.dtype, jnp.inexact):
         return x
+    if jnp.dtype(x.dtype).itemsize < 4:
+        return (x.astype(jnp.float32)
+                * jnp.asarray(prescale, jnp.float32)).astype(x.dtype)
     return x * jnp.asarray(prescale, x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Low-precision wire formats: cast-on-send, fp32-accumulated results.
+# The collective itself runs in the wire dtype (half/quarter the ICI bytes);
+# every scale that touches the bucket (average's 1/size, accumulation's 1/N,
+# fp8's dynamic scale) is applied in fp32 BEFORE the cast, and the reduced
+# result is cast back to the bucket's original dtype immediately after — so
+# everything downstream of the wire (shard updates, optimizer math) runs at
+# full precision and the only loss is the one quantization on send.
+# ---------------------------------------------------------------------------
+
+# fp8 (e4m3) headroom: values are scaled so the WORST-CASE reduced sum
+# (every rank at amax, same sign) lands at half of the 448 format max —
+# range is cheap in e4m3 (17 binades) and the margin keeps rounding in the
+# reduction from saturating into NaN (e4m3fn has no Inf).
+_FP8_MARGIN = 224.0
+
+_WIRE_ALIASES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "fp8": "float8_e4m3fn", "fp8_e4m3": "float8_e4m3fn",
+    "f8e4m3": "float8_e4m3fn", "float8_e4m3fn": "float8_e4m3fn",
+}
+_WIRE_NONE = (None, "", "none", "fp32", "f32", "float32")
+
+
+def resolve_wire_dtype(spec):
+    """Normalize a wire-format spec to a jnp dtype (or None = full
+    precision). Accepts the knob spellings (``"bf16"``, ``"fp8"``), the
+    canonical dtype names, actual dtypes, or None/``"fp32"``. Unknown
+    specs raise eagerly with the supported set named — a typo must not
+    silently train at full precision."""
+    if spec in _WIRE_NONE:
+        return None
+    key = spec if isinstance(spec, str) else jnp.dtype(spec).name
+    key = key.strip().lower()
+    if key in _WIRE_NONE:
+        return None
+    name = _WIRE_ALIASES.get(key)
+    if name is None:
+        raise ValueError(
+            f"unknown wire_dtype {spec!r}: supported are 'bf16', 'fp8' "
+            f"(e4m3 with per-bucket dynamic scaling), or None/'fp32' for "
+            f"full precision")
+    return jnp.dtype(name)
+
+
+def wire_dtype_name(wire) -> str:
+    """Knob spelling of a resolved wire dtype (for stamps/JSON lines)."""
+    w = resolve_wire_dtype(wire)
+    if w is None:
+        return "fp32"
+    return "bf16" if w == jnp.dtype(jnp.bfloat16) else "fp8"
+
+
+def _wire_applies(dtype, wire) -> bool:
+    """A bucket rides the wire format only when it is float and strictly
+    wider than the wire dtype — bf16 buckets under a bf16 wire are already
+    at wire width (no cast), integers never quantize."""
+    return (wire is not None
+            and jnp.issubdtype(dtype, jnp.floating)
+            and jnp.dtype(dtype).itemsize > jnp.dtype(wire).itemsize)
+
+
+def _wire_exchange(flat, axis_names, wire, world, reduce_fn, prescale=None):
+    """One wire-format reduction, shared by the all-reduce and ZeRO
+    planes: fp32 prescale → (fp8: dynamic scale) → ONE cast on send →
+    ``reduce_fn`` in the wire dtype → fp32 result, scale divided back out,
+    cast to the original dtype — fp32 accumulation for everything
+    downstream of the wire.
+
+    fp8 additionally exchanges one scalar ``pmax`` per bucket (the only
+    collective any wire format adds): the per-bucket dynamic scale must be
+    identical on every rank or the scaled values would not share a unit,
+    and the sum of ``world`` in-range values must stay in range — so the
+    scale is ``margin / (world * global_amax)``, applied in fp32 and
+    divided back out of the fp32 result."""
+    orig = flat.dtype
+    x = flat.astype(jnp.float32) if orig != jnp.float32 else flat
+    if prescale is not None:
+        x = x * jnp.asarray(prescale, jnp.float32)
+    scale = None
+    if jnp.dtype(wire).itemsize == 1:
+        amax = jax.lax.pmax(jnp.max(jnp.abs(x)), axis_names)
+        scale = jnp.where(amax > 0, _FP8_MARGIN / (world * amax), 1.0)
+        x = x * scale
+    out = reduce_fn(x.astype(wire)).astype(jnp.float32)
+    if scale is not None:
+        out = out / scale
+    return out.astype(orig)
+
+
+def _wire_sum(flat, axis_names, wire, prescale=None):
+    """Wire-format psum over ``axis_names`` (see :func:`_wire_exchange`)."""
+    world = 1
+    for a in ((axis_names,) if isinstance(axis_names, str)
+              else tuple(axis_names)):
+        world *= int(axis_size(a))
+    return _wire_exchange(
+        flat, axis_names, wire, world,
+        lambda w: jax.lax.psum(w, axis_names), prescale=prescale)
+
+
+def _wire_scatter(flat, axis_name, wire, nshards, prescale=None):
+    """Wire-format ``psum_scatter`` (see :func:`_wire_exchange`): this
+    rank's shard comes back in the bucket's original dtype, so the
+    optimizer update accumulates in fp32 even when the wire carried
+    bf16/fp8."""
+    return _wire_exchange(
+        flat, axis_name, wire, nshards,
+        lambda w: jax.lax.psum_scatter(w, axis_name, tiled=True),
+        prescale=prescale)
 
 
 def fused_allreduce(tree, average: bool = True,
                     fusion_threshold: Optional[int] = None,
                     axis_name: str = AXIS,
                     prescale: Optional[float] = None,
-                    return_finite: bool = False):
+                    return_finite: bool = False,
+                    wire_dtype=None,
+                    overlap: bool = False,
+                    grad_order: Optional[Sequence[int]] = None):
     """Allreduce a pytree with fusion bucketing. Compiled-context only
     (it is the gradient hot path inside the jitted train step).
 
@@ -155,9 +420,28 @@ def fused_allreduce(tree, average: bool = True,
     pass per bucket, before unfusing — sees every rank's poison through
     the psum that already happened. The flag is therefore identical on
     all replicas, which is exactly what a divergence-free skip-step
-    decision needs."""
+    decision needs.
+
+    ``wire_dtype`` (``"bf16"`` / ``"fp8"``) puts float buckets on the wire
+    in reduced precision: every scale is applied in fp32 before ONE cast on
+    send, the collective runs in the wire dtype, and the result is cast
+    back to the bucket's original dtype immediately after (fp32
+    accumulation downstream; see :func:`_wire_sum` — fp8 adds one scalar
+    ``pmax`` per bucket for its dynamic scale, the only extra collective
+    any wire format introduces). The bucket PLAN is unchanged — a wire
+    cast never merges or splits buckets.
+
+    ``overlap=True`` (or a ``grad_order`` from :func:`probe_grad_order`)
+    switches to the backward-overlapped emission: buckets group leaves by
+    backward-completion order (:func:`plan_schedule`) and each bucket's
+    collective is barrier-chained behind the previous one's result, so the
+    per-bucket collectives issue as their gradients complete and XLA hides
+    wire time behind the remaining backward compute. Same total collective
+    count as the non-overlapped plan over the same leaf multiset — overlap
+    reorders, never adds."""
     from .sparse import IndexedSlices, allreduce_indexed_slices
 
+    wire = resolve_wire_dtype(wire_dtype)
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, IndexedSlices))
     if not leaves:
@@ -186,21 +470,46 @@ def fused_allreduce(tree, average: bool = True,
         reduced[i] = r
 
     dense = [leaves[i] for i in dense_idx]
-    buckets = plan_buckets(dense, fusion_threshold)
+    overlap_on = overlap or grad_order is not None
+    if overlap_on:
+        order_d = None
+        if grad_order is not None:
+            # Project the full-tree completion order onto the dense
+            # subsequence (sparse leaves ride their own allgather path).
+            full_to_dense = {fi: di for di, fi in enumerate(dense_idx)}
+            order_d = tuple(full_to_dense[i] for i in grad_order
+                            if i in full_to_dense)
+        buckets = [list(b) for b in
+                   plan_schedule(dense, order_d, fusion_threshold).buckets]
+    else:
+        buckets = plan_buckets(dense, fusion_threshold)
+
+    prev = None
     for bucket in buckets:
         if len(bucket) == 1:
-            j = bucket[0]
+            operand = dense[bucket[0]]
+        else:
+            operand = _fuse([dense[j] for j in bucket])
+        if overlap_on and len(buckets) > 1:
+            operand = _barrier_chain(operand, prev)
+        if _wire_applies(operand.dtype, wire):
+            eff = prescale
+            if op is Op.AVERAGE:
+                inv = 1.0 / int(axis_size(axis_name))
+                eff = inv if eff is None else eff * inv
+            r = _wire_sum(operand, axis_name, wire, prescale=eff)
+        else:
             r = _reduce_in_trace(
-                _prescale_array(dense[j], prescale), op, axis_name)
-            _check(r)
-            reduced[dense_idx[j]] = r
+                _prescale_array(operand, prescale), op, axis_name)
+        if overlap_on:
+            prev = r
+        _check(r)
+        if len(bucket) == 1:
+            reduced[dense_idx[bucket[0]]] = r
         else:
             members = [dense[j] for j in bucket]
-            flat = _reduce_in_trace(
-                _prescale_array(_fuse(members), prescale), op, axis_name)
-            _check(flat)
-            for j, r in zip(bucket, _unfuse(flat, members)):
-                reduced[dense_idx[j]] = r
+            for j, rr in zip(bucket, _unfuse(r, members)):
+                reduced[dense_idx[j]] = rr
     out = jax.tree_util.tree_unflatten(treedef, reduced)
     return (out, finite) if return_finite else out
 
@@ -299,7 +608,9 @@ def fused_reduce_scatter(tree, plan: ZeroPlan, *,
                          average: bool = True,
                          axis_name: str = AXIS,
                          prescale: Optional[float] = None,
-                         return_finite: bool = False):
+                         return_finite: bool = False,
+                         wire_dtype=None,
+                         emit_order: Optional[Sequence[int]] = None):
     """Reduce-scatter a pytree into this rank's flat bucket shards.
 
     Each bucket is flattened, zero-padded to a multiple of the world size,
@@ -317,24 +628,54 @@ def fused_reduce_scatter(tree, plan: ZeroPlan, *,
     folds that AND into the all-gather the updated shards already ride
     (``and_finite=``), keeping the bad-step guard at zero extra collectives
     in ZeRO mode too.
+
+    ``wire_dtype`` (``"bf16"`` / ``"fp8"``) runs the scatter in reduced
+    precision — fp32 prescale, one cast on send, and the received shard
+    cast straight back to the bucket's dtype so the optimizer update
+    accumulates in fp32 (:func:`_wire_scatter`). ``emit_order`` (a bucket
+    permutation from :func:`zero_emit_order`) issues the scatters in
+    backward-readiness order behind ``optimization_barrier`` pins — bucket
+    MEMBERSHIP (and therefore the sharded state layout and the checkpoint
+    canonical form) never changes, only which collective fires first. The
+    returned shard list is always in PLAN order.
     """
+    wire = resolve_wire_dtype(wire_dtype)
     leaves = plan.treedef.flatten_up_to(tree)
     scale = None
     if average and plan.nshards > 1:
         scale = 1.0 / plan.nshards
     if prescale is not None:
         scale = prescale if scale is None else scale * prescale
-    shards = []
+    nb = len(plan.buckets)
+    order = tuple(range(nb)) if emit_order is None \
+        else tuple(int(i) for i in emit_order)
+    if sorted(order) != list(range(nb)):
+        raise ValueError(
+            f"emit_order must be a permutation of the {nb} bucket "
+            f"indices; got {order}")
+    shards: List[Optional[jax.Array]] = [None] * nb
     finite = jnp.ones((), jnp.bool_)
-    for i in range(len(plan.buckets)):
-        flat = _prescale_array(_fuse_bucket(leaves, plan, i), scale)
+    prev = None
+    for i in order:
+        flat = _fuse_bucket(leaves, plan, i)
+        if emit_order is not None and nb > 1:
+            flat = _barrier_chain(flat, prev)
         if plan.nshards > 1:
-            shard = jax.lax.psum_scatter(flat, axis_name, tiled=True)
+            if _wire_applies(flat.dtype, wire):
+                shard = _wire_scatter(flat, axis_name, wire, plan.nshards,
+                                      prescale=scale)
+            else:
+                shard = jax.lax.psum_scatter(
+                    _prescale_array(flat, scale), axis_name, tiled=True)
         else:
-            shard = flat  # single shard: the reduce is the identity
+            # Single shard: the reduce is the identity, and nothing rides
+            # the wire — no quantization round-trip either.
+            shard = _prescale_array(flat, scale)
+        if emit_order is not None:
+            prev = shard
         if return_finite and jnp.issubdtype(shard.dtype, jnp.inexact):
             finite = finite & jnp.all(jnp.isfinite(shard))
-        shards.append(shard)
+        shards[i] = shard
     return (shards, finite) if return_finite else shards
 
 

@@ -44,9 +44,11 @@ import optax
 from . import runtime
 from .ops.collectives import broadcast as _broadcast
 from .ops.fusion import (ZeroPlan, fused_allgather_params, fused_allreduce,
-                         fused_reduce_scatter, plan_zero, shard_params)
+                         fused_reduce_scatter, plan_zero, resolve_wire_dtype,
+                         shard_params, wire_dtype_name, zero_emit_order)
 from .runtime import AXIS
 from .ops.sparse import IndexedSlices, allreduce_indexed_slices
+from .utils import config as _config
 
 
 def _is_sparse_leaf(x) -> bool:
@@ -62,6 +64,13 @@ class Compression:
     fused allreduce and restores the original dtype after, halving
     interconnect bytes per step. Accumulation inside the XLA all-reduce is
     f32 on TPU, so the loss of precision is the single round-trip cast.
+
+    Prefer ``wire_dtype=`` for new code: it casts at the BUCKET level
+    (the fusion plan is unchanged, scales are applied in fp32, and the
+    reduced result returns to fp32 before anything downstream touches
+    it), adds an ``fp8`` format, and composes with ``zero=True`` — on the
+    ZeRO plane ``Compression.bf16`` is accepted as an alias for
+    ``wire_dtype="bf16"`` (see :func:`DistributedOptimizer`).
     """
 
     class none:  # noqa: N801 — enum-style namespace
@@ -224,6 +233,8 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
                         average: bool = True,
                         fusion_threshold: Optional[int] = None,
                         accum_steps: int = 1,
+                        wire_dtype=None,
+                        overlap: bool = False,
                         axis_name: str = AXIS
                         ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with ZeRO-1 sharded updates.
@@ -248,10 +259,21 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
     transform masks keyed on the tree, global-norm clipping) would compute
     per-SHARD instead. ``update`` must run inside the compiled step
     (``make_train_step(zero=True)``) when the world is larger than one.
+
+    ``wire_dtype`` (``"bf16"``/``"fp8"``) runs the reduce-scatter in
+    reduced precision with the received shard cast back to fp32 before
+    the optax update (fp32 shard accumulation); the update all-gather
+    stays at full precision so every replica still ends bit-identical.
+    ``overlap=True`` issues the per-bucket scatters in backward-readiness
+    order behind ``optimization_barrier`` pins (bucket membership — and
+    therefore the sharded-state layout and checkpoint canonical form —
+    never changes); pair it with ``make_train_step(overlap=True)``, which
+    supplies the backward-completion order probe.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     prescale = None if accum_steps <= 1 else 1.0 / accum_steps
+    wire = resolve_wire_dtype(wire_dtype)
 
     def _nshards() -> int:
         return runtime.size() if runtime.is_initialized() else 1
@@ -309,6 +331,7 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
                 "parameter shard locally for the wrapped optimizer "
                 "(weight decay etc.) — call update(grads, state, params)")
         finite_out = extra.pop("finite_out", None)
+        grad_order = extra.pop("grad_order", None)
         plan = state.plan
         if plan.nshards > 1 and not runtime._in_world_trace():
             raise ValueError(
@@ -327,9 +350,12 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
                     f"rank(s) — initialize the state after hvd.init() "
                     f"(or rebuild it for the current world)")
         need_finite = finite_out is not None
+        emit = zero_emit_order(plan, grad_order) \
+            if (overlap or grad_order is not None) else None
         out = fused_reduce_scatter(
             grads, plan, average=average, axis_name=axis_name,
-            prescale=prescale, return_finite=need_finite)
+            prescale=prescale, return_finite=need_finite,
+            wire_dtype=wire, emit_order=emit)
         grad_shards, local_finite = out if need_finite else (out, None)
         p_shards = shard_params(params, plan, axis_name=axis_name)
         # The inner state's array leaves are per-device [1, shard_len]
@@ -352,6 +378,12 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
     update_fn.accum_steps = accum_steps
     update_fn.supports_finite_out = True
     update_fn.zero = True
+    # Knob stamps: make_train_step reads these to thread the backward-
+    # completion probe (overlap) and the env-world plane reads wire_dtype
+    # to cast its host payloads.
+    update_fn.wire_dtype = wire_dtype_name(wire)
+    update_fn.overlap = overlap
+    update_fn.supports_grad_order = True
     # The env-world plane drives the collectives from the host and needs
     # direct access to the wrapped transformation's shard update.
     update_fn.inner_update = optimizer.update
@@ -366,6 +398,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression: Any = Compression.none,
                          accum_steps: int = 1,
                          zero: bool = False,
+                         wire_dtype=None,
+                         overlap: Optional[bool] = None,
                          axis_name: str = AXIS
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with fused gradient allreduce.
@@ -388,31 +422,65 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     compiled step and performs the microbatch mean itself (do NOT set both:
     the gradients would be divided by N twice).
 
+    ``wire_dtype`` (``"bf16"``, ``"fp8"``; default ``HVD_WIRE_DTYPE``) puts
+    float gradient buckets on the wire in reduced precision: scales are
+    applied in fp32, one cast on send, and the reduced result is cast back
+    to the gradient dtype immediately after — fp32 accumulation everywhere
+    downstream (``docs/performance.md`` "Overlap & wire formats"). Unlike
+    ``compression`` it never changes the bucket plan; don't set both on
+    the all-reduce plane (the double cast would be ambiguous — it raises).
+
+    ``overlap`` (default ``HVD_OVERLAP``) arms backward-overlapped bucket
+    emission: per-bucket collectives issue in backward-completion order
+    behind ``optimization_barrier`` pins so wire time hides behind the
+    remaining backward compute. The completion order itself is probed by
+    ``make_train_step(overlap=True)`` — set it there (or via the env var)
+    and this wrapper picks it up from the step's ``grad_order`` channel.
+
     ``zero=True`` switches to ZeRO-1 sharded updates
     (:func:`partition_optimizer`): the fused all-reduce becomes a fused
     reduce-scatter + all-gather over the SAME buckets (same bytes on the
     wire), each rank holds and updates ``1/size()`` of the optimizer state,
     and the returned state is a :class:`ZeroShardedState`. Build the step
     with ``make_train_step(zero=True)`` (or ``HVD_ZERO=1``). Composes with
-    ``accum_steps`` and the bad-step guard; ``compression`` does not (the
-    scatter's accumulation dtype is the gradient dtype — raise an issue
-    before casting blindly) and sparse gradients must be densified
-    (``sparse_as_dense=True``).
+    ``accum_steps``, the bad-step guard, ``wire_dtype`` (the scatter rides
+    the wire dtype with fp32 shard accumulation before the optax update;
+    the update all-gather stays full-precision so replicas end
+    bit-identical) and ``overlap``; ``compression=Compression.bf16`` is
+    accepted as an alias for ``wire_dtype="bf16"`` here. Sparse gradients
+    must be densified (``sparse_as_dense=True``).
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    wire = resolve_wire_dtype(
+        wire_dtype if wire_dtype is not None
+        else _config.wire_dtype_default())
+    if overlap is None:
+        overlap = _config.overlap_enabled()
 
     if zero:
-        if compression is not Compression.none:
+        if compression is Compression.bf16:
+            # The old eager rejection is gone: a bf16-compressed scatter
+            # IS the bf16 wire format — the received shard is cast back to
+            # fp32 before the optax update, so the f32 accumulation the
+            # fused all-reduce path keeps is preserved here too.
+            if wire is None:
+                wire = jnp.dtype(jnp.bfloat16)
+            elif wire != jnp.dtype(jnp.bfloat16):
+                raise ValueError(
+                    f"compression=Compression.bf16 (the bf16 wire alias) "
+                    f"conflicts with wire_dtype={wire_dtype_name(wire)!r} "
+                    f"— set wire_dtype alone")
+        elif compression is not Compression.none:
             raise ValueError(
-                "zero=True does not compose with gradient compression: "
-                "the reduce-scatter's accumulation dtype is the wire "
-                "dtype, so a bf16-compressed scatter would lose the f32 "
-                "accumulation the fused all-reduce path keeps — use one "
-                "or the other")
+                "unsupported compression for zero=True: the ZeRO plane "
+                "expresses compression as a wire format — use "
+                "wire_dtype='bf16'/'fp8' (Compression.bf16 is accepted "
+                "as an alias)")
         part = partition_optimizer(
             optimizer, average=average, fusion_threshold=fusion_threshold,
-            accum_steps=accum_steps, axis_name=axis_name)
+            accum_steps=accum_steps, wire_dtype=wire, overlap=overlap,
+            axis_name=axis_name)
         if not sparse_as_dense:
             return part
 
@@ -425,12 +493,20 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             return part.update(_densify(grads), state, params, **extra)
 
         for attr in ("accum_steps", "supports_finite_out", "zero",
-                     "inner_update"):
+                     "inner_update", "wire_dtype", "overlap",
+                     "supports_grad_order"):
             setattr(zero_update, attr, getattr(part.update, attr))
         # The env-world plane flattens grads itself (it never enters this
         # wrapper) and consults the stamp to densify before bucketing.
         zero_update.sparse_as_dense = True
         return optax.GradientTransformation(part.init, zero_update)
+
+    if wire is not None and compression is not Compression.none:
+        raise ValueError(
+            "compression= and wire_dtype= both set: compression casts "
+            "whole leaves before bucketing while wire_dtype casts each "
+            "bucket at the collective (fp32 scales and accumulation) — "
+            "pick one (wire_dtype is the recommended form)")
 
     def init_fn(params):
         return optimizer.init(params)
@@ -445,17 +521,16 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         # gate params/opt_state on. In-trace only: the dict holds a
         # tracer for the duration of the surrounding trace.
         finite_out = extra.pop("finite_out", None)
+        grad_order = extra.pop("grad_order", None)
+        kw = dict(average=average, fusion_threshold=fusion_threshold,
+                  sparse_as_dense=sparse_as_dense, compression=compression,
+                  accum_steps=accum_steps, axis_name=axis_name,
+                  wire_dtype=wire, overlap=overlap, grad_order=grad_order)
         if finite_out is None:
-            grads = allreduce_gradients(
-                grads, average=average, fusion_threshold=fusion_threshold,
-                sparse_as_dense=sparse_as_dense, compression=compression,
-                accum_steps=accum_steps, axis_name=axis_name)
+            grads = allreduce_gradients(grads, **kw)
         else:
             grads, all_finite = allreduce_gradients(
-                grads, average=average, fusion_threshold=fusion_threshold,
-                sparse_as_dense=sparse_as_dense, compression=compression,
-                accum_steps=accum_steps, axis_name=axis_name,
-                return_finite=True)
+                grads, return_finite=True, **kw)
             finite_out["all_finite"] = all_finite
         return optimizer.update(grads, state, params, **extra)
 
@@ -466,6 +541,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     # finite_out channel into optimizers that declare it (a plain optax
     # transformation would choke on the unknown kwarg).
     update_fn.supports_finite_out = True
+    # Knob stamps: the step builder reads overlap to arm its grad-order
+    # probe; the env-world plane reads wire_dtype to cast host payloads.
+    update_fn.wire_dtype = wire_dtype_name(wire)
+    update_fn.overlap = overlap
+    update_fn.supports_grad_order = True
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -476,14 +556,21 @@ def allreduce_gradients(grads,
                         compression: Any = Compression.none,
                         accum_steps: int = 1,
                         axis_name: str = AXIS,
-                        return_finite: bool = False):
+                        return_finite: bool = False,
+                        wire_dtype=None,
+                        overlap: bool = False,
+                        grad_order: Optional[Tuple[int, ...]] = None):
     """Allreduce a gradient pytree: dense leaves via fused flat buckets,
     sparse leaves via allgather (``horovod/tensorflow/__init__.py:61-79``).
     ``accum_steps > 1`` divides by the local microbatch count (the caller
     passes a gradient *sum* over N backward passes) as a prescale fused
     into the bucket traversal. ``return_finite=True`` additionally
     returns the world-wide all-finite scalar derived inside the same
-    traversal (see :func:`~horovod_tpu.ops.fusion.fused_allreduce`)."""
+    traversal (see :func:`~horovod_tpu.ops.fusion.fused_allreduce`).
+    ``wire_dtype``/``overlap``/``grad_order`` pass through to the fused
+    traversal (low-precision wire + backward-overlapped emission); the
+    size-1 fast path ignores the wire — nothing travels, so nothing
+    quantizes."""
     prescale = None if accum_steps <= 1 else 1.0 / accum_steps
     if runtime.is_initialized() and runtime.size() == 1 \
             and not runtime._in_world_trace():
@@ -539,7 +626,9 @@ def allreduce_gradients(grads,
     reduced = fused_allreduce(compressed, average=average,
                               fusion_threshold=fusion_threshold,
                               axis_name=axis_name, prescale=prescale,
-                              return_finite=return_finite)
+                              return_finite=return_finite,
+                              wire_dtype=wire_dtype, overlap=overlap,
+                              grad_order=grad_order)
     if return_finite:
         reduced, all_finite = reduced
     out = jax.tree_util.tree_map(

@@ -73,7 +73,8 @@ def named_sharding_tree(mesh: Mesh, tree, spec_fn=None):
     return jax.tree_util.tree_map_with_path(shard, tree)
 
 
-def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=()):
+def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=(),
+                      wire_dtype=None):
     """Gradient sync for spec-sharded parameter trees (runs INSIDE
     shard_map). One implementation shared by both transformer families —
     the collective-gradient math is subtle enough that duplicating it is
@@ -94,9 +95,21 @@ def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=()):
     their per-rank partials go through the pmean above, and the factor
     does not compound across layers because partial cotangents are
     re-summed — not amplified — by the next psum transpose).
+
+    ``wire_dtype`` (``"bf16"``/``"fp8"``) runs each replicated-axis
+    gradient average on the wire in reduced precision — same contract as
+    the fused-bucket planes (``ops/fusion.py``): the ``1/world`` average
+    and any fp8 dynamic scale are applied in fp32 before one cast on
+    send, and the reduced result returns to the leaf's dtype immediately
+    after. tp-sharded leaves' compiler-inserted psums are untouched
+    (those carry activations' cotangents, not the gradient exchange).
     """
     from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from ..ops.fusion import _wire_applies, _wire_sum, resolve_wire_dtype
+
+    wire = resolve_wire_dtype(wire_dtype)
 
     def sync(spec, g):
         leaf_axes = {ax for s in spec if s
@@ -104,7 +117,13 @@ def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=()):
         over = tuple(a for a in mesh_axes
                      if a not in leaf_axes and a not in skip_axes)
         if over:
-            g = lax.pmean(g, over)
+            if _wire_applies(g.dtype, wire):
+                world = 1
+                for a in over:
+                    world *= int(lax.axis_size(a))
+                g = _wire_sum(g, over, wire, prescale=1.0 / world)
+            else:
+                g = lax.pmean(g, over)
         if "tp" in leaf_axes and "tp" in mesh_axes:
             g = g / lax.axis_size("tp")
         return g

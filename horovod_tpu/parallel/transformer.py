@@ -304,13 +304,19 @@ def chunked_nll(x, embed, labels, cfg: TransformerConfig):
 
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
                              optimizer: optax.GradientTransformation,
-                             aux_weight: float = 0.01):
+                             aux_weight: float = 0.01,
+                             wire_dtype=None):
     """Build (init_state, step): the compiled multi-axis training step.
 
     ``init_state(rng)`` returns (params, opt_state) as global sharded
     arrays; ``step(params, opt_state, tokens, labels)`` runs one update and
     returns (params, opt_state, loss). tokens/labels are global
     [B, T] int32, sharded (dp, sp).
+
+    ``wire_dtype`` (``"bf16"``/``"fp8"``; see ``docs/performance.md``
+    "Overlap & wire formats") runs the data-parallel gradient averages in
+    reduced wire precision with fp32 scales and fp32 result accumulation
+    (:func:`~horovod_tpu.parallel.mesh.grad_sync_by_spec`).
     """
     axes = _axes(mesh)
     if cfg.n_experts and "ep" in axes \
@@ -331,7 +337,8 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
         # Shared spec-driven sync (see parallel/mesh.py): pmean over each
         # leaf's replicated axes + the tp psum-transpose correction.
         from .mesh import grad_sync_by_spec
-        return grad_sync_by_spec(grads, specs, axes)
+        return grad_sync_by_spec(grads, specs, axes,
+                                 wire_dtype=wire_dtype)
 
     def _loss_fn(params, tokens, labels):
         if cfg.loss_chunk:

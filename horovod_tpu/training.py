@@ -229,6 +229,8 @@ def create_train_state(model, rng, sample_input, optimizer,
                        fusion_threshold: Optional[int] = None,
                        compression: Any = Compression.none,
                        zero: Optional[bool] = None,
+                       wire_dtype=None,
+                       overlap: Optional[bool] = None,
                        has_batch_stats: Optional[bool] = None,
                        model_kwargs: Optional[dict] = None) -> Tuple[
                            TrainState, optax.GradientTransformation]:
@@ -245,6 +247,11 @@ def create_train_state(model, rng, sample_input, optimizer,
     optimizer state is rank-sharded (1/size() per device) and the step
     must be built with ``make_train_step(zero=True)`` — which it picks up
     automatically from the optimizer's capability stamp.
+
+    ``wire_dtype`` (default: ``HVD_WIRE_DTYPE``) and ``overlap`` (default:
+    ``HVD_OVERLAP``) pass through to the ``DistributedOptimizer`` — the
+    low-precision wire format and backward-overlapped bucket emission
+    (``docs/performance.md`` "Overlap & wire formats").
     """
     from .utils import config as _config
     if zero is None:
@@ -256,7 +263,8 @@ def create_train_state(model, rng, sample_input, optimizer,
         batch_stats = None
     dist_opt = DistributedOptimizer(
         optimizer, average=average, fusion_threshold=fusion_threshold,
-        compression=compression, zero=zero)
+        compression=compression, zero=zero, wire_dtype=wire_dtype,
+        overlap=overlap)
     if (zero and runtime.is_initialized() and runtime.size() > 1
             and not runtime.world().env_world):
         # The ZeRO opt state is committed to the world mesh (stacked
@@ -290,7 +298,8 @@ def make_train_step(model,
                     accum_unroll: Optional[int] = None,
                     remat: Any = False,
                     guard_nonfinite: Optional[bool] = None,
-                    zero: Optional[bool] = None):
+                    zero: Optional[bool] = None,
+                    overlap: Optional[bool] = None):
     """Build the compiled SPMD train step.
 
     The returned function has signature ``step(state, batch) -> (state,
@@ -339,6 +348,19 @@ def make_train_step(model,
     ``guard_nonfinite`` (the world-wide all-finite flag rides the
     all-gather the updated shards already take — zero extra collectives —
     and a skip leaves the SHARDED opt state bit-unchanged).
+
+    ``overlap`` (default: ``HVD_OVERLAP``, or the optimizer's stamp) arms
+    backward-overlapped bucket collectives: a one-time traced-jaxpr probe
+    (:func:`~horovod_tpu.ops.fusion.probe_grad_order`, cached per input
+    shapes) records the order the backward pass materializes each
+    gradient leaf, and the fused exchange issues one collective per
+    bucket in that order behind ``optimization_barrier`` pins — so XLA
+    schedules each bucket's wire time behind the remaining backward
+    compute instead of serializing one post-backward blob. Total
+    collective count is unchanged (overlap reorders, never adds); on the
+    ZeRO plane bucket membership is pinned by the plan and only emission
+    order changes. Composes with ``wire_dtype`` on the optimizer
+    (``docs/performance.md`` "Overlap & wire formats").
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -376,8 +398,61 @@ def make_train_step(model,
             "DistributedOptimizer — the gradients would be divided by N "
             "twice; set it in one place (make_train_step owns the "
             "microbatch scan and its 1/N)")
+    if overlap is None:
+        from .utils import config as _config
+        overlap = bool(getattr(dist_opt.update, "overlap", False)) \
+            or _config.overlap_enabled()
+    if overlap and not getattr(dist_opt.update, "supports_grad_order",
+                               False):
+        raise ValueError(
+            "overlap=True (or HVD_OVERLAP=1) requires a "
+            "DistributedOptimizer-wrapped optimizer: the backward-"
+            "completion order is threaded into its fused collective "
+            "traversal (the grad_order channel); a plain optax "
+            "transformation has no collectives to overlap (wrap it with "
+            "horovod_tpu.DistributedOptimizer(...))")
     mesh = mesh if mesh is not None else runtime.mesh()
     vag = _build_value_and_grad(model, loss_fn, remat)
+
+    # Backward-completion probe (overlap mode): one abstract trace per
+    # input-shape signature, host-side and OUTSIDE the step trace, so the
+    # jitted program reads a plain static tuple. The order is a pure
+    # function of the traced program — identical across processes and
+    # across re-traces of the same shapes, so the jit cache key does not
+    # need to carry it.
+    _overlap_probe: dict = {"key": None, "order": None}
+
+    def _probe_overlap(state, inputs, labels):
+        if not overlap:
+            return None
+        key = (
+            tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
+                  for l in jax.tree_util.tree_leaves(state.params)),
+            tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
+                  for l in jax.tree_util.tree_leaves((inputs, labels))),
+        )
+        if key != _overlap_probe["key"]:
+            from .ops.fusion import probe_grad_order
+            _overlap_probe["order"] = probe_grad_order(
+                lambda p: vag(p, state.batch_stats, inputs, labels,
+                              jax.random.PRNGKey(0))[1], state.params)
+            _overlap_probe["key"] = key
+        return None
+
+    def _overlap_kwargs(grads):
+        """Static grad_order kwarg for the optimizer update (trace time).
+        Falls back to flatten order — plan-order emission with barrier
+        pins, still unmergeable and deterministic — when the probe could
+        not rank the leaves or the tree carries sparse leaves (whose
+        flatten arity differs from the probe's)."""
+        if not overlap:
+            return {}
+        from .optimizer import _is_sparse_leaf
+        n = len(jax.tree_util.tree_leaves(grads, is_leaf=_is_sparse_leaf))
+        order = _overlap_probe["order"]
+        if order is None or len(order) != n:
+            order = tuple(range(n))
+        return {"grad_order": order}
 
     def _step(state: TrainState, inputs, labels):
         # Fresh dropout mask per step and per rank: fold the step counter
@@ -398,15 +473,16 @@ def make_train_step(model,
                 accum_steps, metrics_fn, unroll=accum_unroll)
         # DistributedOptimizer performs the fused allreduce over `axis_name`
         # — on the accumulated (microbatch-mean) tree, once per step.
+        upd_kwargs = _overlap_kwargs(grads)
         if guard_nonfinite:
             finite_out: dict = {}
             updates, new_opt_state = dist_opt.update(
                 grads, state.opt_state, state.params,
-                finite_out=finite_out)
+                finite_out=finite_out, **upd_kwargs)
             all_finite = finite_out["all_finite"]
         else:
             updates, new_opt_state = dist_opt.update(
-                grads, state.opt_state, state.params)
+                grads, state.opt_state, state.params, **upd_kwargs)
         new_params = optax.apply_updates(state.params, updates)
         new_stats = new_stats if new_stats is not None else state.batch_stats
         metrics = {"loss": jax.lax.pmean(loss, axis_name)}
@@ -498,10 +574,12 @@ def make_train_step(model,
             inputs, labels = batch
             if accum_steps > 1:
                 _check_accum_batch(inputs, accum_steps, n_shards)
+            _probe_overlap(state, inputs, labels)
             return _zero_jitted(state)(state, inputs, labels)
 
-        step.lower = lambda state, batch: \
-            _zero_jitted(state).lower(state, *batch)
+        step.lower = lambda state, batch: (
+            _probe_overlap(state, *batch)
+            or _zero_jitted(state).lower(state, *batch))
         return step
 
     @functools.wraps(jitted)
@@ -509,13 +587,15 @@ def make_train_step(model,
         inputs, labels = batch
         if accum_steps > 1:
             _check_accum_batch(inputs, accum_steps, n_shards)
+        _probe_overlap(state, inputs, labels)
         return jitted(state, inputs, labels)
 
     # AOT handle (jax .lower convention): lets callers inspect the compiled
     # artifact — e.g. count the all-reduce ops to verify fusion bucketing
     # survived compilation (tests/test_fusion.py pins this; with
     # accum_steps > 1 the count proves the psum sits outside the scan).
-    step.lower = lambda state, batch: jitted.lower(state, *batch)
+    step.lower = lambda state, batch: (
+        _probe_overlap(state, *batch) or jitted.lower(state, *batch))
     return step
 
 
@@ -548,6 +628,37 @@ def _is_env_world(mesh) -> bool:
         return False
     w = runtime.world()
     return w.env_world and w.coord is not None
+
+
+def _env_wire_np(dist_opt):
+    """Resolve the optimizer's wire stamp for the host coordination plane:
+    bf16 payloads ride the coordinator wire natively (its reduction widens
+    to f32 and narrows back — the same fp32-accumulation guarantee the
+    compiled plane pins); fp8 has no host wire dtype and is rejected with
+    the remedy named rather than silently training at full precision."""
+    import numpy as np
+    wire_name = getattr(dist_opt.update, "wire_dtype", "fp32")
+    if wire_name == "fp8":
+        raise ValueError(
+            "wire_dtype='fp8' is compiled-plane only: the host "
+            "coordinator wire carries bf16 (reduced with f32 "
+            "accumulation) but has no fp8 dtype — use wire_dtype='bf16' "
+            "under tpurun")
+    if wire_name == "bf16":
+        return np.dtype(jnp.bfloat16)
+    return None
+
+
+def _env_wire_cast(payload, wire_np):
+    """Cast one host bucket payload onto the wire dtype; returns
+    ``(payload, orig_dtype_or_None)`` — the receive side casts back so
+    everything downstream of the wire stays full precision."""
+    import numpy as np
+    if (wire_np is not None
+            and np.issubdtype(payload.dtype, np.floating)
+            and payload.dtype.itemsize > wire_np.itemsize):
+        return payload.astype(wire_np), payload.dtype
+    return payload, None
 
 
 def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
@@ -590,6 +701,7 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
 
     w = runtime.world()
     vag = _build_value_and_grad(model, loss_fn, remat)
+    wire_np = _env_wire_np(dist_opt)
 
     def _grads(state: TrainState, inputs, labels):
         step_rng = jax.random.fold_in(
@@ -635,7 +747,8 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
     if zero:
         return _make_env_world_zero_step(
             dist_opt, grads_jit, counter, w,
-            accum_steps=accum_steps, guard_nonfinite=guard_nonfinite)
+            accum_steps=accum_steps, guard_nonfinite=guard_nonfinite,
+            wire_np=wire_np)
 
     def step(state: TrainState, batch):
         import numpy as np
@@ -657,12 +770,15 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         tag = counter["n"]
         buckets = plan_buckets(leaves)
         handles = []
+        wire_origs = []
         for bi, bucket in enumerate(buckets):
             if len(bucket) == 1:
                 payload = np.asarray(leaves[bucket[0]])
             else:
                 payload = np.concatenate(
                     [np.ravel(np.asarray(leaves[j])) for j in bucket])
+            payload, orig = _env_wire_cast(payload, wire_np)
+            wire_origs.append(orig)
             handles.append(w.coord.submit(
                 "allreduce", payload, f"grad.{tag}.{bi}", op=Op.AVERAGE))
         metric_handles = {"loss": w.coord.submit(
@@ -677,6 +793,11 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         all_finite = True
         for bi, bucket in enumerate(buckets):
             out = np.asarray(w.coord.wait(handles[bi]))
+            if wire_origs[bi] is not None:
+                # Off the wire, back to full precision: the coordinator
+                # reduced the bf16 payload in f32 and narrowed once; the
+                # gradient tree downstream stays in its original dtype.
+                out = out.astype(wire_origs[bi])
             if guard_nonfinite and np.issubdtype(out.dtype, np.inexact):
                 # Checked while still flat — one pass per REDUCED bucket,
                 # mirroring the compiled plane's in-trace check. The
@@ -715,11 +836,17 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
 
 def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
                               accum_steps: int,
-                              guard_nonfinite: bool):
+                              guard_nonfinite: bool,
+                              wire_np=None):
     """The ZeRO half of the env-world plane (see
     :func:`_make_env_world_step`): coordinator reduce-scatter → jitted
     local-shard optimizer update → coordinator all-gather of the updated
-    shards (+ the guard's finite flag) → jitted apply."""
+    shards (+ the guard's finite flag) → jitted apply. ``wire_np`` (bf16)
+    casts the scatter payloads on send; the received shard is cast back
+    to its original dtype BEFORE the jitted shard update — fp32 shard
+    accumulation, mirroring the compiled plane — while the update
+    all-gather stays full-precision so every rank rebuilds bit-identical
+    params."""
     import numpy as np
 
     from .ops.collectives import Op
@@ -789,6 +916,7 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
         pres = getattr(dist_opt.update, "accum_steps", 1)
 
         handles = []
+        wire_origs = []
         for bi, bucket in enumerate(plan.buckets):
             if len(bucket) == 1:
                 flat = np.ravel(np.asarray(leaves[bucket[0]]))
@@ -796,7 +924,15 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
                 flat = np.concatenate(
                     [np.ravel(np.asarray(leaves[j])) for j in bucket])
             if pres > 1 and np.issubdtype(flat.dtype, np.inexact):
-                flat = flat * flat.dtype.type(1.0 / pres)
+                if flat.dtype.itemsize < 4:
+                    # Sub-fp32 buckets scale in fp32, one cast at the end
+                    # (same rule as fusion._prescale_array).
+                    flat = (flat.astype(np.float32)
+                            * np.float32(1.0 / pres)).astype(flat.dtype)
+                else:
+                    flat = flat * flat.dtype.type(1.0 / pres)
+            flat, orig = _env_wire_cast(flat, wire_np)
+            wire_origs.append(orig)
             pad = plan.padded[bi] - plan.sizes[bi]
             if pad:
                 flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
@@ -812,6 +948,9 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
                 f"metric.{k}.{tag}", op=Op.AVERAGE)
 
         shards = [np.asarray(w.coord.wait(h)) for h in handles]
+        shards = [s if wire_origs[bi] is None
+                  else s.astype(wire_origs[bi])
+                  for bi, s in enumerate(shards)]
         local_finite = True
         if guard_nonfinite:
             # Mirrors the compiled plane: the reduced shard carries every

@@ -84,6 +84,27 @@ def zero_enabled() -> bool:
         "1", "true", "yes", "on")
 
 
+def overlap_enabled() -> bool:
+    """``HVD_OVERLAP`` — default for backward-overlapped bucket collectives
+    (``make_train_step(overlap=...)``): per-bucket gradient collectives are
+    emitted in backward-completion order behind ``optimization_barrier``
+    pins so XLA's scheduler hides wire time behind the remaining backward
+    compute (``docs/performance.md`` "Overlap & wire formats"). Off unless
+    set to 1/true/yes/on."""
+    return os.environ.get("HVD_OVERLAP", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def wire_dtype_default() -> str | None:
+    """``HVD_WIRE_DTYPE`` — default low-precision wire format for gradient
+    collectives (``DistributedOptimizer(wire_dtype=...)``): ``bf16`` or
+    ``fp8`` (e4m3, per-bucket dynamic scaling); empty/``fp32`` means full
+    precision. Resolution/validation lives in
+    :func:`horovod_tpu.ops.fusion.resolve_wire_dtype`."""
+    raw = os.environ.get("HVD_WIRE_DTYPE", "").strip().lower()
+    return raw or None
+
+
 # Consecutive skipped (non-finite) steps tolerated before Trainer.fit
 # rolls back to the last verified checkpoint / raises NonFiniteGradError.
 DEFAULT_MAX_BAD_STEPS: int = 5

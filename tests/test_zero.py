@@ -250,10 +250,19 @@ def test_zero_optimizer_requires_zero_step():
         training.make_train_step(model, dist_opt, zero=False)
 
 
-def test_zero_rejects_compression():
-    with pytest.raises(ValueError, match="compression"):
+def test_zero_composes_with_compression():
+    """ISSUE 6: the old eager `zero=True does not compose with gradient
+    compression` rejection is lifted — Compression.bf16 is the bf16 wire
+    format on the ZeRO plane (scatter in bf16, fp32 shard accumulation
+    before the optax update)."""
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), zero=True,
+                                   compression=hvd.Compression.bf16)
+    assert getattr(opt.update, "wire_dtype", None) == "bf16"
+    # A conflicting explicit wire format still raises eagerly.
+    with pytest.raises(ValueError, match="conflicts"):
         hvd.DistributedOptimizer(optax.sgd(0.1), zero=True,
-                                 compression=hvd.Compression.bf16)
+                                 compression=hvd.Compression.bf16,
+                                 wire_dtype="fp8")
 
 
 def test_env_default_arms_zero(monkeypatch):
