@@ -427,12 +427,15 @@ def _lm_config():
 
 
 def measure_lm(cfg=None) -> float:
-    """Tokens/sec of the compiled transformer-LM train step (one dp axis
-    over all visible devices). Returns total (not per-chip) throughput.
+    """Tokens/sec of the compiled transformer-LM train step over all
+    visible devices — a pure dp mesh by default, or dp×tp with
+    ``cfg["tp"] > 1`` (the hybrid plane: Megatron-sharded weights, batch
+    over dp; ISSUE 8). Returns total (not per-chip) throughput.
     Single-controller only: the parallel transformer's mesh covers this
     process's devices, so an env-world run would train unsynced local
     replicas and report a meaningless rate."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from horovod_tpu.parallel.mesh import create_hybrid_mesh
     from horovod_tpu.parallel.transformer import (
         TransformerConfig, make_parallel_train_step)
 
@@ -448,8 +451,19 @@ def measure_lm(cfg=None) -> float:
             "without tpurun; one process drives all chips)")
 
     devs = jax.devices()
-    mesh = Mesh(np.array(devs), ("dp",))
     n = len(devs)
+    tp = int(cfg.get("tp", 1))
+    if tp < 1 or n % tp:
+        raise SystemExit(
+            f"--tp {tp} must divide the visible device count {n} "
+            f"(the mesh is dp={n}//tp × tp)")
+    dp = n // tp
+    want_dp = cfg.get("mesh_dp")
+    if want_dp is not None and int(want_dp) != dp:
+        raise SystemExit(
+            f"--mesh dp={want_dp},tp={tp} does not match the visible "
+            f"device count {n} (needs dp×tp == devices; dp here is {dp})")
+    mesh = create_hybrid_mesh(dp=dp, tp=tp)
     tcfg = TransformerConfig(
         vocab=cfg["vocab"], d_model=cfg["d_model"], n_heads=cfg["n_heads"],
         n_layers=cfg["n_layers"], d_ff=cfg["d_ff"], dtype=jnp.bfloat16,
@@ -458,10 +472,15 @@ def measure_lm(cfg=None) -> float:
         loss_chunk=int(cfg.get("loss_chunk", 0)))
     opt = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     init_state, step = make_parallel_train_step(
-        tcfg, mesh, opt, wire_dtype=cfg.get("wire_dtype"))
+        tcfg, mesh, opt, wire_dtype=cfg.get("wire_dtype"),
+        zero=bool(cfg.get("zero", False)),
+        overlap=True if cfg.get("overlap") else None,
+        accum_steps=int(cfg.get("accum_steps", 1)))
     params, opt_state = init_state(jax.random.PRNGKey(0))
 
-    B = cfg["batch_per_chip"] * n
+    # tp ranks within a dp group replicate the same rows, so the global
+    # batch scales with dp, not the chip count.
+    B = cfg["batch_per_chip"] * dp
     T = cfg["seq"]
     rng = np.random.RandomState(0)
     sharding = NamedSharding(mesh, P("dp", None))
@@ -507,16 +526,31 @@ def measure_lm(cfg=None) -> float:
     return rate
 
 
-def lm_line(wire_dtype=None) -> dict:
+def _mesh_desc(n: int, tp: int) -> str:
+    dp = n // max(1, tp)
+    return f"dp{dp}" + (f",tp{tp}" if tp > 1 else "")
+
+
+def lm_line(wire_dtype=None, tp: int = 1, zero: bool = False,
+            overlap: bool = False, accum_steps: int = 1,
+            mesh_dp=None) -> dict:
     from horovod_tpu.ops.fusion import wire_dtype_name
     cfg = _lm_config()
     if wire_dtype:
         cfg["wire_dtype"] = wire_dtype
+    cfg["tp"] = tp
+    cfg["zero"] = zero
+    cfg["overlap"] = overlap
+    cfg["accum_steps"] = accum_steps
+    cfg["mesh_dp"] = mesh_dp
     rate = measure_lm(cfg)
-    per_chip = rate / hvd.size()
+    n = hvd.size()
+    per_chip = rate / n
     gflop_tok = lm_train_gflop_per_token(cfg)
     # Hardware-ratio baseline, like the conv models: the reference GPU's
-    # estimated tokens/sec at this FLOPs cost.
+    # estimated tokens/sec at this FLOPs cost. With tp the per-chip FLOPs
+    # fall by tp (the model is split), so the per-chip token rate is
+    # still the apples-to-apples number.
     baseline = BASELINE_IMG_PER_SEC_PER_DEVICE * (
         TRAIN_GFLOP_PER_IMAGE["resnet101"] / gflop_tok)
     line = {
@@ -524,14 +558,25 @@ def lm_line(wire_dtype=None) -> dict:
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / baseline, 3),
+        # per_chip = rate / ALL chips already spreads each token's FLOPs
+        # over the tp split — no further /tp, or hybrid MFU reads tp×
+        # low vs the tp=1 rows.
         "tflops_per_chip": round(per_chip * gflop_tok / 1e3, 1),
-        # Knob provenance (ISSUE 6): overlap is a fused-bucket-plane knob —
-        # the GSPMD transformer has no explicit bucket collectives, so it
-        # is structurally off here; the wire knob applies to its dp-plane
-        # gradient averages.
-        "overlap": False,
+        # Knob provenance (ISSUEs 6+8): since the retarget onto the core
+        # stack, the LM rides the same fused-bucket planes as the conv
+        # family — every knob applies and is recorded.
+        "accum_steps": int(accum_steps),
+        "zero": bool(zero),
+        "overlap": bool(overlap),
         "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
+        "tp": int(tp),
+        "mesh": _mesh_desc(n, tp),
     }
+    # The hybrid HBM win (weights + opt state ÷ tp, opt state ÷ dp with
+    # --zero) is only claimable if the line carries the number.
+    peak_bytes = _peak_bytes_per_chip()
+    if peak_bytes is not None:
+        line["peak_bytes_per_chip"] = peak_bytes
     peak = _peak_tflops_per_chip()
     if peak:
         line["mfu"] = round(per_chip * gflop_tok / 1e3 / peak, 3)
@@ -580,34 +625,58 @@ def main() -> None:
                         "collectives (fp32 scales, fp32 result "
                         "accumulation; fp8 is e4m3 with per-bucket "
                         "dynamic scaling); recorded in every JSON line")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel axis size for the hybrid dp×tp "
+                        "mesh (transformer_lm only: Megatron-sharded "
+                        "weights over tp, batch over dp=devices//tp; "
+                        "docs/performance.md 'Hybrid dp×tp'); recorded "
+                        "in every JSON line alongside 'mesh'")
+    p.add_argument("--mesh", default=None,
+                   help="explicit mesh spec 'dp=N,tp=M' (must multiply "
+                        "to the visible device count); equivalent to "
+                        "--tp M with a dp sanity check")
     args = p.parse_args()
     if args.accum_steps < 1:
         raise SystemExit(f"--accum-steps must be >= 1, got "
                          f"{args.accum_steps}")
+    tp = args.tp
+    mesh_dp = None
+    if args.mesh:
+        import re as _re
+        sizes = {}
+        for part in args.mesh.split(","):
+            m = _re.match(r"^\s*(dp|tp)\s*=?\s*(\d+)\s*$", part)
+            if not m:
+                raise SystemExit(
+                    f"--mesh expects 'dp=N,tp=M' (got {part!r}); axes "
+                    f"beyond dp/tp are examples/transformer_lm.py "
+                    f"territory")
+            sizes[m.group(1)] = int(m.group(2))
+        mtp = sizes.get("tp", 1)
+        if tp != 1 and tp != mtp:
+            raise SystemExit(
+                f"--tp {tp} conflicts with --mesh {args.mesh!r}")
+        tp = mtp
+        mesh_dp = sizes.get("dp")
+    if tp < 1:
+        raise SystemExit(f"--tp must be >= 1, got {tp}")
     if args.model == "transformer_lm":
-        if args.accum_steps > 1:
-            raise SystemExit(
-                "--accum-steps applies to the conv family (the "
-                "make_train_step path); the parallel transformer has its "
-                "own pipeline-microbatching knobs")
-        if args.zero:
-            raise SystemExit(
-                "--zero applies to the conv family (the "
-                "DistributedOptimizer path); the parallel transformer "
-                "shards its optimizer over the mesh already")
-        if args.overlap:
-            raise SystemExit(
-                "--overlap applies to the conv family (the fused-bucket "
-                "collective planes); the parallel transformer's "
-                "collectives are compiler-placed by GSPMD — a silent "
-                "ignore would mislabel the measurement")
         if args.scaling:
             raise SystemExit(
                 "--scaling is not supported for transformer_lm (the conv "
                 "family's re-init-with-device-subsets machinery does not "
                 "apply); run it without --scaling")
-        print(json.dumps(lm_line(wire_dtype=args.wire_dtype)))
+        print(json.dumps(lm_line(
+            wire_dtype=args.wire_dtype, tp=tp, zero=bool(args.zero),
+            overlap=bool(args.overlap), accum_steps=args.accum_steps,
+            mesh_dp=mesh_dp)))
         return
+    if tp > 1:
+        raise SystemExit(
+            "--tp/--mesh tp>1 applies to --model transformer_lm (the "
+            "hybrid dp×tp workload): the conv family's flax models are "
+            "not tensor-sharded — a silent ignore would mislabel a pure-"
+            "dp run as a hybrid measurement")
     cfg = _bench_config(args.model or "resnet50")
     cfg["accum_steps"] = args.accum_steps
     cfg["zero"] = bool(args.zero)
@@ -635,6 +704,11 @@ def main() -> None:
             "zero": bool(cfg.get("zero", False)),
             "overlap": bool(cfg.get("overlap", False)),
             "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
+            # The conv family is pure dp (flax models are not tensor-
+            # sharded); the fields still appear so every JSON line is
+            # mesh-attributable.
+            "tp": 1,
+            "mesh": _mesh_desc(hvd.size(), 1),
         }
 
     if args.scaling:
@@ -713,7 +787,9 @@ def main() -> None:
             print("skipping transformer_lm line: single-controller only",
                   file=sys.stderr)
         else:
-            print(json.dumps(lm_line(wire_dtype=args.wire_dtype)),
+            print(json.dumps(lm_line(wire_dtype=args.wire_dtype,
+                                     zero=bool(args.zero),
+                                     overlap=bool(args.overlap))),
                   flush=True)
 
 

@@ -55,6 +55,8 @@ from .ops.collectives import (  # noqa: F401
 from .ops.sparse import IndexedSlices  # noqa: F401
 from .ops.fusion import (  # noqa: F401
     BucketSchedule,
+    GradSync,
+    plan_grad_sync,
     plan_schedule,
     probe_grad_order,
     resolve_wire_dtype,

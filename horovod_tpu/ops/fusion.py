@@ -47,16 +47,21 @@ from .collectives import Op, _reduce_in_trace
 
 def _greedy_scan(key, order, fusion_threshold: int):
     """The fusion scan over leaves visited in ``order``: fuse while the
-    dtype matches and cumulative bytes stay within the threshold; close the
-    bucket at the first non-fusable tensor (``mpi_ops.cc:1414-1419`` —
-    never look ahead, never reorder within the visit order)."""
+    dtype (and, on an N-D mesh, the reduce-axis group — see
+    :func:`plan_grad_sync`) matches and cumulative bytes stay within the
+    threshold; close the bucket at the first non-fusable tensor
+    (``mpi_ops.cc:1414-1419`` — never look ahead, never reorder within the
+    visit order). ``key[i]`` is ``(shape, dtype)`` or
+    ``(shape, dtype, group)``; two leaves fuse only when BOTH dtype and
+    group agree — a bucket rides exactly one collective, so its members
+    must share the axes that collective reduces over."""
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_dtype = None
     cur_bytes = 0
     for i in order:
-        shape, dtype = key[i]
-        nbytes = int(math.prod(shape)) * np.dtype(dtype).itemsize
+        shape, dtype = key[i][0], key[i][1:]
+        nbytes = int(math.prod(shape)) * np.dtype(key[i][1]).itemsize
         fusable = (
             fusion_threshold > 0
             and cur
@@ -91,7 +96,8 @@ def _plan_cached(key: Tuple[Tuple[Tuple[int, ...], str], ...],
 
 
 def plan_buckets(leaves: Sequence[jax.Array],
-                 fusion_threshold: Optional[int] = None) -> List[List[int]]:
+                 fusion_threshold: Optional[int] = None,
+                 groups: Optional[Sequence[Any]] = None) -> List[List[int]]:
     """Partition leaf indices into fusion buckets, preserving order.
 
     Mirrors the coordinator's fusion scan (``mpi_ops.cc:1395-1422``): walk the
@@ -99,14 +105,29 @@ def plan_buckets(leaves: Sequence[jax.Array],
     the threshold; close the bucket at the first non-fusable tensor.
     ``fusion_threshold=0`` disables fusion (one bucket per tensor).
 
-    The scan is cached per ``(shapes, dtypes, threshold)`` — see
+    ``groups`` (optional, one hashable per leaf) adds a second fusion key
+    next to dtype: leaves fuse only within the same group. This is how the
+    N-D mesh plane keeps tp-sharded weight gradients (psum over ``dp``
+    only) out of the buckets carrying replicated leaves (psum over the
+    full mesh) — a bucket rides ONE collective, so its members must agree
+    on the reduce axes (:func:`plan_grad_sync` builds the keys).
+
+    The scan is cached per ``(shapes, dtypes, groups, threshold)`` — see
     :func:`_plan_cached`; callers get a fresh mutable copy each call, so
     mutating a returned plan cannot poison the cache.
     """
     if fusion_threshold is None:
         fusion_threshold = _config.fusion_threshold_bytes()
-    key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
-                for leaf in leaves)
+    if groups is None:
+        key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+                    for leaf in leaves)
+    else:
+        if len(groups) != len(leaves):
+            raise ValueError(
+                f"groups must align with leaves: {len(groups)} group keys "
+                f"for {len(leaves)} leaves")
+        key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)), g)
+                    for leaf, g in zip(leaves, groups))
     return [list(b) for b in _plan_cached(key, int(fusion_threshold))]
 
 
@@ -149,19 +170,26 @@ def _schedule_cached(key, order, fusion_threshold: int):
 
 def plan_schedule(leaves: Sequence[jax.Array],
                   grad_order: Optional[Sequence[int]] = None,
-                  fusion_threshold: Optional[int] = None) -> BucketSchedule:
+                  fusion_threshold: Optional[int] = None,
+                  groups: Optional[Sequence[Any]] = None) -> BucketSchedule:
     """Build the overlap emission schedule for ``leaves``.
 
     ``grad_order`` is the backward-completion permutation of leaf indices
     (:func:`probe_grad_order`); None falls back to flatten order, which
-    degrades to the non-overlapped grouping. Same caching contract as
-    :func:`plan_buckets` — keyed on resolved (shapes, dtypes, order,
-    threshold), so an env-var threshold flip between calls still
+    degrades to the non-overlapped grouping. ``groups`` adds the same
+    per-leaf reduce-axis fusion key :func:`plan_buckets` takes — on an N-D
+    mesh leaves only fuse within their spec group. Same caching contract
+    as :func:`plan_buckets` — keyed on resolved (shapes, dtypes, groups,
+    order, threshold), so an env-var threshold flip between calls still
     invalidates."""
     if fusion_threshold is None:
         fusion_threshold = _config.fusion_threshold_bytes()
-    key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
-                for leaf in leaves)
+    if groups is None:
+        key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+                    for leaf in leaves)
+    else:
+        key = tuple((tuple(leaf.shape), str(jnp.dtype(leaf.dtype)), g)
+                    for leaf, g in zip(leaves, groups))
     order = (tuple(range(len(key))) if grad_order is None
              else tuple(int(i) for i in grad_order))
     if sorted(order) != list(range(len(key))):
@@ -387,6 +415,162 @@ def _wire_scatter(flat, axis_name, wire, nshards, prescale=None):
         prescale=prescale)
 
 
+# ---------------------------------------------------------------------------
+# Axis-aware collective planning (ISSUE 8 tentpole): on an N-D named mesh
+# ('dp', 'tp', ...) the per-leaf gradient-sync decision is a PLAN, not a
+# hard-coded world axis. Each leaf's PartitionSpec determines (a) which axes
+# its gradient must be summed over — every mesh axis the leaf is REPLICATED
+# across — and (b) the averaging denominator, including the tp
+# psum-transpose correction (under full-manual shard_map the transpose of
+# the row-parallel psum is psum, so tp-sharded weight grads arrive
+# multiplied by tp — the rule parallel/mesh.grad_sync_by_spec pinned
+# empirically). Leaves group by that decision: tp-sharded weight grads psum
+# over dp ONLY, replicated leaves keep the full-mesh path, and the two
+# never share a bucket.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GradSync:
+    """One leaf's gradient-sync decision on an N-D mesh (hashable — it is
+    the fusion-group key :func:`plan_buckets` scans on).
+
+    ``psum``: mesh axes the gradient is summed over (the leaf is
+    replicated across exactly these). ``shard``: mesh axes the leaf itself
+    is sharded over (``psum`` ∪ ``shard`` = the mesh axes minus
+    ``skip_axes``). ``denom``: the averaging denominator — the product of
+    the ``psum`` axis sizes times the tp correction for tp-sharded leaves.
+    """
+
+    psum: Tuple[str, ...]
+    shard: Tuple[str, ...]
+    denom: int
+
+
+def _spec_axes(spec) -> set:
+    """Mesh axis names a PartitionSpec references (entries may be a name,
+    a tuple of names, or None)."""
+    axes = set()
+    for s in (spec or ()):
+        if s is None:
+            continue
+        axes.update((s,) if isinstance(s, str) else s)
+    return axes
+
+
+def plan_grad_sync(specs: Sequence[Any], mesh,
+                   *, skip_axes: Tuple[str, ...] = ()) -> List[GradSync]:
+    """Per-leaf :class:`GradSync` for a flat list of ``PartitionSpec``s
+    over ``mesh`` (a named N-D mesh). The decision mirrors
+    ``parallel/mesh.grad_sync_by_spec`` exactly — psum over every mesh
+    axis the leaf is replicated across (minus ``skip_axes``), averaged by
+    the product of those axis sizes, with the extra ``1/tp`` on tp-sharded
+    leaves (the psum-transpose factor) folded into ``denom`` so the whole
+    correction rides the bucket's one fused prescale multiply."""
+    mesh_axes = tuple(mesh.axis_names)
+    sizes = dict(mesh.shape)
+    out = []
+    for spec in specs:
+        leaf_axes = _spec_axes(spec)
+        over = tuple(a for a in mesh_axes
+                     if a not in leaf_axes and a not in skip_axes)
+        shard = tuple(a for a in mesh_axes
+                      if a in leaf_axes and a not in skip_axes)
+        denom = 1
+        for a in over:
+            denom *= int(sizes[a])
+        if "tp" in leaf_axes and "tp" in sizes:
+            denom *= int(sizes["tp"])
+        out.append(GradSync(psum=over, shard=shard, denom=denom))
+    return out
+
+
+def _grouped_allreduce(leaves, treedef, syncs: Sequence[GradSync],
+                       fusion_threshold, prescale, return_finite, wire,
+                       overlap_on: bool, grad_order):
+    """The N-D (spec-grouped) half of :func:`fused_allreduce`: leaves
+    bucket within their :class:`GradSync` group (same psum axes, same
+    denominator), each bucket rides ONE ``lax.psum`` over its group's
+    axes, and the group's ``1/denom`` average folds into the same fp32
+    prescale multiply the accumulation scale uses.
+
+    ``return_finite``: buckets psum'd over the FULL reduce set propagate
+    any rank's NaN/Inf to every rank, so their flags are mesh-consistent
+    for free; buckets reduced over a strict subset (tp-sharded weight
+    grads, psum over dp only) leave per-rank flags — those are folded
+    with one scalar ``pmin`` over the missing axes, the only collective
+    the guard adds on the hybrid plane (documented in
+    docs/performance.md; the 1-D plane stays at zero extra)."""
+    # GradSync is frozen/hashable — the object IS the fusion-group key,
+    # so the allreduce and ZeRO planes cannot drift on what "same group"
+    # means (plan_zero passes the same objects).
+    groups = list(syncs)
+    if overlap_on:
+        order = None if grad_order is None \
+            else tuple(int(i) for i in grad_order)
+        buckets = [list(b) for b in
+                   plan_schedule(leaves, order, fusion_threshold,
+                                 groups=groups).buckets]
+    else:
+        buckets = plan_buckets(leaves, fusion_threshold, groups=groups)
+
+    # The full reduce set: flags from buckets summed over all of it are
+    # identical on every rank; anything less needs the pmin fold below.
+    all_axes = set()
+    for s in syncs:
+        all_axes.update(s.psum)
+    reduced: List[Optional[jax.Array]] = [None] * len(leaves)
+    finite_full = jnp.ones((), jnp.bool_)
+    finite_partial = jnp.ones((), jnp.bool_)
+    missing_union: set = set()
+    prev = None
+    for bucket in buckets:
+        sync = syncs[bucket[0]]
+        if len(bucket) == 1:
+            operand = leaves[bucket[0]]
+        else:
+            operand = _fuse([leaves[j] for j in bucket])
+        if overlap_on and len(buckets) > 1:
+            operand = _barrier_chain(operand, prev)
+        eff = prescale
+        if sync.denom > 1:
+            inv = 1.0 / sync.denom
+            eff = inv if eff is None else eff * inv
+        if sync.psum:
+            if _wire_applies(operand.dtype, wire):
+                r = _wire_sum(operand, sync.psum, wire, prescale=eff)
+            else:
+                r = jax.lax.psum(_prescale_array(operand, eff), sync.psum)
+        else:
+            # Fully sharded across every mesh axis: nothing to exchange,
+            # only the correction scale applies.
+            r = _prescale_array(operand, eff)
+        if overlap_on:
+            prev = r
+        if return_finite and jnp.issubdtype(r.dtype, jnp.inexact):
+            flag = jnp.all(jnp.isfinite(r))
+            missing = all_axes - set(sync.psum)
+            if missing:
+                finite_partial = finite_partial & flag
+                missing_union.update(missing)
+            else:
+                finite_full = finite_full & flag
+        if len(bucket) == 1:
+            reduced[bucket[0]] = r
+        else:
+            members = [leaves[j] for j in bucket]
+            for j, rr in zip(bucket, _unfuse(r, members)):
+                reduced[j] = rr
+    out = treedef.unflatten(reduced)
+    if not return_finite:
+        return out
+    if missing_union:
+        finite_partial = jax.lax.pmin(
+            finite_partial.astype(jnp.int32),
+            tuple(sorted(missing_union))) > 0
+    return out, finite_full & finite_partial
+
+
 def fused_allreduce(tree, average: bool = True,
                     fusion_threshold: Optional[int] = None,
                     axis_name: str = AXIS,
@@ -394,9 +578,21 @@ def fused_allreduce(tree, average: bool = True,
                     return_finite: bool = False,
                     wire_dtype=None,
                     overlap: bool = False,
-                    grad_order: Optional[Sequence[int]] = None):
+                    grad_order: Optional[Sequence[int]] = None,
+                    reduce_axes: Optional[Sequence[GradSync]] = None):
     """Allreduce a pytree with fusion bucketing. Compiled-context only
     (it is the gradient hot path inside the jitted train step).
+
+    ``reduce_axes`` (a per-leaf :class:`GradSync` list from
+    :func:`plan_grad_sync`, aligned with the tree's flatten order) switches
+    to the N-D spec-grouped plane: leaves bucket within their reduce-axis
+    group, each bucket psums over ITS group's axes (tp-sharded weight grads
+    over ``dp`` only; replicated leaves over the full mesh), and the
+    group's averaging denominator — including the tp psum-transpose
+    correction — folds into the bucket's one fused prescale. Requires
+    ``average=True`` (the denominators define the averaging semantics) and
+    dense leaves (sparse trees stay on the 1-D plane); ``axis_name`` is
+    ignored in this mode.
 
     Sparse (:class:`~horovod_tpu.ops.sparse.IndexedSlices`) leaves are kept
     whole and routed through the two-allgather sparse path — never flattened
@@ -446,6 +642,26 @@ def fused_allreduce(tree, average: bool = True,
         tree, is_leaf=lambda x: isinstance(x, IndexedSlices))
     if not leaves:
         return (tree, jnp.ones((), jnp.bool_)) if return_finite else tree
+    if reduce_axes is not None:
+        if not average:
+            raise ValueError(
+                "reduce_axes= (the spec-grouped N-D plane) defines "
+                "averaging semantics via per-group denominators — "
+                "average=False has no meaning there")
+        if any(isinstance(l, IndexedSlices) for l in leaves):
+            raise ValueError(
+                "reduce_axes= requires dense gradients: IndexedSlices "
+                "leaves have no per-axis spec grouping (densify with "
+                "sparse_as_dense=True)")
+        if len(reduce_axes) != len(leaves):
+            raise ValueError(
+                f"reduce_axes must align with the gradient tree: "
+                f"{len(reduce_axes)} GradSync entries for {len(leaves)} "
+                f"leaves")
+        return _grouped_allreduce(
+            leaves, treedef, reduce_axes, fusion_threshold, prescale,
+            return_finite, wire, overlap or grad_order is not None,
+            grad_order)
     op = Op.AVERAGE if average else Op.SUM
     reduced: List[Optional[jax.Array]] = [None] * len(leaves)
     finite = jnp.ones((), jnp.bool_)
@@ -536,7 +752,21 @@ class ZeroPlan:
     length per bucket (``padded[i]`` is the smallest multiple of
     ``nshards`` >= ``sizes[i]``, so ``lax.psum_scatter(tiled=True)`` splits
     evenly), ``shapes``/``dtypes`` the member leaves' layout for unfusing,
-    and ``treedef`` the original tree structure."""
+    and ``treedef`` the original tree structure.
+
+    On an N-D mesh (``plan_zero(specs=, mesh=)``) the plan is keyed by the
+    reduce-axis tuple of each leaf's PartitionSpec: buckets group within a
+    spec group (tp-sharded weight grads never share a bucket with
+    replicated leaves), ``shapes``/``sizes``/``padded`` describe the
+    LOCAL (per-tp-shard) blocks the in-trace collectives see while
+    ``global_shapes`` keeps the mesh-agnostic layout the 2-D canonical
+    checkpoint form is defined on, and the per-bucket ``extra_axes`` /
+    ``shard_axes`` / ``denoms`` record the group's collective plan:
+    reduce-scatter over ``scatter_axis`` (dp), an extra psum over the axes
+    the bucket is replicated across, averaged by the group denominator
+    (including the tp psum-transpose correction). Bucket MEMBERSHIP is
+    planned on global shapes, so it is identical across (dp, tp) reshapes
+    of the same axis set."""
 
     buckets: Tuple[Tuple[int, ...], ...]
     sizes: Tuple[int, ...]
@@ -545,27 +775,105 @@ class ZeroPlan:
     dtypes: Tuple[str, ...]
     treedef: Any
     nshards: int
+    # --- N-D (hybrid-mesh) extension; defaults = the 1-D world plan. ---
+    scatter_axis: Optional[str] = None
+    denoms: Optional[Tuple[int, ...]] = None
+    extra_axes: Optional[Tuple[Tuple[str, ...], ...]] = None
+    shard_axes: Optional[Tuple[Tuple[str, ...], ...]] = None
+    nonscatter: Tuple[Tuple[str, int], ...] = ()
+    leaf_specs: Optional[Tuple[Any, ...]] = None
+    global_shapes: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    @property
+    def hybrid(self) -> bool:
+        return self.leaf_specs is not None
 
     def shard_len(self, i: int) -> int:
         return self.padded[i] // self.nshards
 
+    def bucket_denom(self, i: int) -> int:
+        return self.nshards if self.denoms is None else self.denoms[i]
+
+    def bucket_extra(self, i: int) -> Tuple[str, ...]:
+        return () if self.extra_axes is None else self.extra_axes[i]
+
+    def bucket_shard_axes(self, i: int) -> Tuple[str, ...]:
+        return () if self.shard_axes is None else self.shard_axes[i]
+
+    def bucket_ns(self, i: int) -> int:
+        """Product of the sizes of the nonscatter axes bucket ``i``'s
+        leaves are sharded over — the stacked array's tp-fold factor."""
+        sizes = dict(self.nonscatter)
+        n = 1
+        for a in self.bucket_shard_axes(i):
+            n *= int(sizes[a])
+        return n
+
     def shard_shapes(self):
-        """Per-bucket ``(nshards, shard_len)`` — the stacked layout the
-        sharded optimizer state stores (leading axis split one shard per
-        rank over the world mesh)."""
-        return tuple((self.nshards, self.shard_len(i))
+        """Per-bucket stacked-array shape: ``(nshards, shard_len)`` on the
+        1-D world; ``(nshards, ns · shard_len)`` on a hybrid mesh, where
+        ``ns`` folds the bucket's tp-like shard axes into the trailing dim
+        (block ``[:, c·s:(c+1)·s]`` is nonscatter-coordinate ``c``'s dp
+        stack). Replicated buckets keep ``ns == 1`` — their state is
+        stored once and REPLICATED over tp by sharding, not materialized
+        per tp rank."""
+        return tuple((self.nshards, self.bucket_ns(i) * self.shard_len(i))
                      for i in range(len(self.buckets)))
+
+    def canonical_sizes(self):
+        """Per-bucket length of the world- AND mesh-agnostic canonical
+        form: the flat concatenation of the bucket's GLOBAL leaves —
+        identical no matter how the saving run split (dp, tp)."""
+        if not self.hybrid:
+            return self.sizes
+        out = []
+        for b in self.buckets:
+            out.append(sum(int(math.prod(self.global_shapes[j]))
+                           for j in b))
+        return tuple(out)
+
+
+def _local_shape(shape, spec, axis_sizes) -> Tuple[int, ...]:
+    """The per-device block shape of a leaf laid out by ``spec`` (one mesh
+    axis per dim at most — the Megatron layouts this plane supports)."""
+    out = list(shape)
+    for d, s in enumerate(spec or ()):
+        if s is None:
+            continue
+        axes = (s,) if isinstance(s, str) else tuple(s)
+        if len(axes) > 1:
+            raise ValueError(
+                f"ZeRO spec-grouped plans support one mesh axis per "
+                f"tensor dim; got {spec} (dim {d} sharded over {axes})")
+        n = int(axis_sizes[axes[0]])
+        if out[d] % n:
+            raise ValueError(
+                f"dim {d} of shape {tuple(shape)} does not divide by the "
+                f"{axes[0]}={n} mesh axis (spec {spec})")
+        out[d] //= n
+    return tuple(out)
 
 
 def plan_zero(tree, nshards: int,
-              fusion_threshold: Optional[int] = None) -> ZeroPlan:
+              fusion_threshold: Optional[int] = None,
+              *, specs=None, mesh=None, scatter_axis: str = "dp",
+              skip_axes: Tuple[str, ...] = ()) -> ZeroPlan:
     """Build the sharded-update layout for ``tree`` over ``nshards`` ranks.
 
     Sparse (:class:`~horovod_tpu.ops.sparse.IndexedSlices`) leaves cannot
     be flattened into rank-sharded dense buckets (their integer indices
     must not be summed, and a slice of a slice has no owner rank) — a tree
     carrying them raises; densify first (``sparse_as_dense``) or keep the
-    replicated optimizer for sparse models."""
+    replicated optimizer for sparse models.
+
+    ``specs=`` + ``mesh=`` build the N-D (hybrid-mesh) plan: leaves group
+    by their :class:`GradSync` spec group (:func:`plan_grad_sync`), bucket
+    membership is scanned on GLOBAL shapes — so the plan (and therefore
+    the canonical checkpoint form) is identical across (dp, tp) reshapes
+    of the same axis names — and the optimizer state shards over
+    ``scatter_axis`` (dp) for tp-sharded and replicated leaves alike.
+    ``tree`` holds the global params; ``nshards`` must equal the mesh's
+    ``scatter_axis`` size."""
     from .sparse import IndexedSlices
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, IndexedSlices))
@@ -577,21 +885,89 @@ def plan_zero(tree, nshards: int,
             "replicated DistributedOptimizer for sparse models)")
     if nshards < 1:
         raise ValueError(f"nshards must be >= 1, got {nshards}")
-    buckets = plan_buckets(leaves, fusion_threshold)
+
+    if specs is None:
+        buckets = plan_buckets(leaves, fusion_threshold)
+        sizes = []
+        padded = []
+        for b in buckets:
+            n = sum(int(math.prod(leaves[j].shape)) for j in b)
+            sizes.append(n)
+            padded.append(-(-n // nshards) * nshards)
+        return ZeroPlan(
+            buckets=tuple(tuple(b) for b in buckets),
+            sizes=tuple(sizes),
+            padded=tuple(padded),
+            shapes=tuple(tuple(l.shape) for l in leaves),
+            dtypes=tuple(str(jnp.dtype(l.dtype)) for l in leaves),
+            treedef=treedef,
+            nshards=nshards,
+        )
+
+    if mesh is None:
+        raise ValueError("plan_zero(specs=...) requires mesh= (the named "
+                         "hybrid mesh the specs refer to)")
+    if scatter_axis not in mesh.shape:
+        raise ValueError(
+            f"scatter_axis {scatter_axis!r} is not an axis of the mesh "
+            f"{dict(mesh.shape)} — ZeRO shards the optimizer state over "
+            f"the data-parallel axis")
+    if nshards != int(mesh.shape[scatter_axis]):
+        raise ValueError(
+            f"nshards={nshards} does not match the mesh's "
+            f"{scatter_axis}={mesh.shape[scatter_axis]} — the ZeRO shard "
+            f"count IS the {scatter_axis} axis size on a hybrid mesh")
+    from jax.sharding import PartitionSpec as P
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    if len(spec_leaves) != len(leaves):
+        raise ValueError(
+            f"param_specs tree has {len(spec_leaves)} specs for "
+            f"{len(leaves)} parameter leaves — the trees must mirror")
+    syncs = plan_grad_sync(spec_leaves, mesh, skip_axes=skip_axes)
+    axis_sizes = dict(mesh.shape)
+    for spec, sync in zip(spec_leaves, syncs):
+        if scatter_axis not in sync.psum:
+            raise ValueError(
+                f"a parameter with spec {spec} is sharded over the "
+                f"scatter axis {scatter_axis!r} — ZeRO-over-{scatter_axis}"
+                f" requires params replicated across it (shard weights "
+                f"over tp/sp/ep, data over {scatter_axis})")
+    buckets = plan_buckets(leaves, fusion_threshold, groups=list(syncs))
+    local_shapes = [
+        _local_shape(l.shape, spec, axis_sizes)
+        for l, spec in zip(leaves, spec_leaves)]
     sizes = []
     padded = []
+    denoms = []
+    extra = []
+    shard_ax = []
     for b in buckets:
-        n = sum(int(math.prod(leaves[j].shape)) for j in b)
+        n = sum(int(math.prod(local_shapes[j])) for j in b)
         sizes.append(n)
         padded.append(-(-n // nshards) * nshards)
+        sync = syncs[b[0]]
+        denoms.append(sync.denom)
+        extra.append(tuple(a for a in sync.psum if a != scatter_axis))
+        shard_ax.append(sync.shard)
+    nonscatter = tuple(
+        (a, int(axis_sizes[a])) for a in mesh.axis_names
+        if a != scatter_axis and a not in skip_axes)
     return ZeroPlan(
         buckets=tuple(tuple(b) for b in buckets),
         sizes=tuple(sizes),
         padded=tuple(padded),
-        shapes=tuple(tuple(l.shape) for l in leaves),
+        shapes=tuple(local_shapes),
         dtypes=tuple(str(jnp.dtype(l.dtype)) for l in leaves),
         treedef=treedef,
         nshards=nshards,
+        scatter_axis=scatter_axis,
+        denoms=tuple(denoms),
+        extra_axes=tuple(extra),
+        shard_axes=tuple(shard_ax),
+        nonscatter=nonscatter,
+        leaf_specs=tuple(spec_leaves),
+        global_shapes=tuple(tuple(l.shape) for l in leaves),
     )
 
 
@@ -638,14 +1014,20 @@ def fused_reduce_scatter(tree, plan: ZeroPlan, *,
     MEMBERSHIP (and therefore the sharded state layout and the checkpoint
     canonical form) never changes, only which collective fires first. The
     returned shard list is always in PLAN order.
+
+    Hybrid (N-D) plans: the scatter runs over the plan's ``scatter_axis``
+    (dp) with the GROUP denominator — including the tp psum-transpose
+    correction — folded into the fp32 prescale; buckets replicated across
+    the nonscatter axes take one extra ``lax.psum`` over those axes on the
+    already-scattered 1/dp shard (the cheapest place for the Megatron-side
+    sum). With ``return_finite`` the rank-local flag is folded with one
+    scalar ``pmin`` over the nonscatter axes — tp-sharded buckets take no
+    tp collective, so a NaN there is visible to one tp rank only; the
+    pmin is the only collective the guard adds on the hybrid plane (the
+    1-D plane stays at zero extra).
     """
     wire = resolve_wire_dtype(wire_dtype)
     leaves = plan.treedef.flatten_up_to(tree)
-    scale = None
-    if average and plan.nshards > 1:
-        scale = 1.0 / plan.nshards
-    if prescale is not None:
-        scale = prescale if scale is None else scale * prescale
     nb = len(plan.buckets)
     order = tuple(range(nb)) if emit_order is None \
         else tuple(int(i) for i in emit_order)
@@ -657,6 +1039,12 @@ def fused_reduce_scatter(tree, plan: ZeroPlan, *,
     finite = jnp.ones((), jnp.bool_)
     prev = None
     for i in order:
+        scale = None
+        denom = plan.bucket_denom(i)
+        if average and denom > 1:
+            scale = 1.0 / denom
+        if prescale is not None:
+            scale = prescale if scale is None else scale * prescale
         flat = _fuse_bucket(leaves, plan, i)
         if emit_order is not None and nb > 1:
             flat = _barrier_chain(flat, prev)
@@ -671,11 +1059,21 @@ def fused_reduce_scatter(tree, plan: ZeroPlan, *,
             # Single shard: the reduce is the identity, and nothing rides
             # the wire — no quantization round-trip either.
             shard = _prescale_array(flat, scale)
+        extra = plan.bucket_extra(i)
+        if extra:
+            # Replicated-group bucket on a hybrid mesh: the tp-side sum,
+            # taken on the 1/dp shard (dp-fold fewer elements than a
+            # pre-scatter psum would touch).
+            shard = jax.lax.psum(shard, extra)
         if emit_order is not None:
             prev = shard
         if return_finite and jnp.issubdtype(shard.dtype, jnp.inexact):
             finite = finite & jnp.all(jnp.isfinite(shard))
         shards[i] = shard
+    if return_finite and plan.nonscatter:
+        finite = jax.lax.pmin(
+            finite.astype(jnp.int32),
+            tuple(a for a, _ in plan.nonscatter)) > 0
     return (shards, finite) if return_finite else shards
 
 
@@ -711,6 +1109,101 @@ def _unfuse_flat(flats, plan: ZeroPlan):
             reduced[j] = jnp.reshape(flat[offset:offset + n], plan.shapes[j])
             offset += n
     return plan.treedef.unflatten(reduced)
+
+
+def zero_stacked_spec(plan: ZeroPlan, i: int, axis_name: str = AXIS):
+    """PartitionSpec of bucket ``i``'s stacked optimizer-state array:
+    ``P(scatter)`` on the 1-D world (``axis_name``), ``P(dp, shard_axes)``
+    on a hybrid mesh — the leading dim splits one shard per dp rank, the
+    trailing dim splits over the tp-like axes the bucket's leaves are
+    sharded over (replicated buckets leave it whole: their state is
+    replicated over tp by SHARDING, not materialized per tp rank)."""
+    from jax.sharding import PartitionSpec as P
+    scatter = plan.scatter_axis if plan.scatter_axis is not None \
+        else axis_name
+    sa = plan.bucket_shard_axes(i)
+    return P(scatter, sa) if sa else P(scatter)
+
+
+def _ns_coords(plan: ZeroPlan, i: int):
+    """Nonscatter coordinates of bucket ``i``'s shard axes, in the
+    row-major order ``PartitionSpec(scatter, shard_axes)`` splits the
+    stacked array's trailing dim — block ``[:, c·s:(c+1)·s]`` of the
+    stacked array is coordinate ``c``'s dp stack."""
+    import itertools
+    axes = plan.bucket_shard_axes(i)
+    sizes = dict(plan.nonscatter)
+    for coord in itertools.product(*[range(int(sizes[a])) for a in axes]):
+        yield dict(zip(axes, coord))
+
+
+def _block_index(shape, spec, coord, axis_sizes):
+    """Slice tuple selecting the local block of a global array at
+    nonscatter coordinate ``coord`` under ``spec``."""
+    idx = []
+    for d in range(len(shape)):
+        s = spec[d] if spec is not None and d < len(spec) else None
+        if s is None:
+            idx.append(slice(None))
+            continue
+        a = s if isinstance(s, str) else tuple(s)[0]
+        if a not in coord:
+            idx.append(slice(None))
+            continue
+        w = shape[d] // int(axis_sizes[a])
+        idx.append(slice(coord[a] * w, (coord[a] + 1) * w))
+    return tuple(idx)
+
+
+def zero_stack_global(leaves, plan: ZeroPlan, i: int) -> np.ndarray:
+    """Build bucket ``i``'s stacked optimizer-state array from GLOBAL
+    leaves (host-side; init and checkpoint-restore both use it): for each
+    nonscatter coordinate, slice the bucket members' local blocks, flatten
+    + rank-pad + stack ``[nshards, shard_len]``, and concatenate the
+    coordinates along the trailing dim. 1-D plans degrade to the plain
+    flatten-pad-stack."""
+    axis_sizes = dict(plan.nonscatter)
+    s = plan.shard_len(i)
+    pad = plan.padded[i] - plan.sizes[i]
+    cols = []
+    for coord in (_ns_coords(plan, i) if plan.hybrid else ({},)):
+        parts = []
+        for j in plan.buckets[i]:
+            arr = np.asarray(leaves[j])
+            if plan.hybrid:
+                arr = arr[_block_index(arr.shape, plan.leaf_specs[j],
+                                       coord, axis_sizes)]
+            parts.append(np.ravel(arr))
+        flat = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        if pad:
+            flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+        cols.append(flat.reshape(plan.nshards, s))
+    return cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+
+
+def zero_unstack_global(stacked, plan: ZeroPlan, i: int) -> List[np.ndarray]:
+    """Inverse of :func:`zero_stack_global`: bucket ``i``'s GLOBAL leaves
+    from its stacked ``[nshards, ns·shard_len]`` array."""
+    axis_sizes = dict(plan.nonscatter)
+    stacked = np.asarray(stacked)
+    s = plan.shard_len(i)
+    out = [np.zeros(plan.global_shapes[j] if plan.hybrid
+                    else plan.shapes[j], stacked.dtype)
+           for j in plan.buckets[i]]
+    for ci, coord in enumerate(_ns_coords(plan, i) if plan.hybrid
+                               else ({},)):
+        flat = stacked[:, ci * s:(ci + 1) * s].reshape(-1)[:plan.sizes[i]]
+        off = 0
+        for k, j in enumerate(plan.buckets[i]):
+            n = int(math.prod(plan.shapes[j]))
+            block = flat[off:off + n].reshape(plan.shapes[j])
+            off += n
+            if plan.hybrid:
+                out[k][_block_index(out[k].shape, plan.leaf_specs[j],
+                                    coord, axis_sizes)] = block
+            else:
+                out[k] = block
+    return out
 
 
 def fused_allgather_params(shards, plan: ZeroPlan, *,

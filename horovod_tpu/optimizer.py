@@ -44,8 +44,10 @@ import optax
 from . import runtime
 from .ops.collectives import broadcast as _broadcast
 from .ops.fusion import (ZeroPlan, fused_allgather_params, fused_allreduce,
-                         fused_reduce_scatter, plan_zero, resolve_wire_dtype,
-                         shard_params, wire_dtype_name, zero_emit_order)
+                         fused_reduce_scatter, plan_grad_sync, plan_zero,
+                         resolve_wire_dtype, shard_params, wire_dtype_name,
+                         zero_emit_order, zero_stack_global,
+                         zero_stacked_spec, zero_unstack_global)
 from .runtime import AXIS
 from .ops.sparse import IndexedSlices, allreduce_indexed_slices
 from .utils import config as _config
@@ -170,17 +172,30 @@ def zero_to_canonical(state: ZeroShardedState, *,
     ``np.zeros`` stand-ins (for building orbax restore templates without
     touching device data). No-op for env-world local-shard states (their
     leaves are ``[1, shard_len]`` with ``nshards > 1`` — only this rank's
-    slice exists locally, so there is nothing world-agnostic to write)."""
+    slice exists locally, so there is nothing world-agnostic to write).
+
+    Hybrid (N-D mesh) plans extend the form to 2-D: the canonical vector
+    is the flat concatenation of the bucket's GLOBAL leaves — the
+    per-tp-coordinate dp stacks are unstacked and reassembled into the
+    unsharded arrays first (:func:`~horovod_tpu.ops.fusion.
+    zero_unstack_global`) — so the bytes are identical across BOTH world
+    sizes and (dp, tp) mesh reshapes: a ``(dp=4, tp=2)`` checkpoint
+    restores at ``(dp=2, tp=4)``."""
     plan = state.plan
     ids = _zero_shard_leaf_buckets(state.inner, plan)
     leaves, treedef = jax.tree_util.tree_flatten(state.inner)
+    canon_sizes = plan.canonical_sizes()
     out = []
     for leaf, b in zip(leaves, ids):
         if b is None:
             out.append(leaf)
         elif placeholders:
-            out.append(np.zeros((plan.sizes[b],),
+            out.append(np.zeros((canon_sizes[b],),
                                 np.dtype(plan.dtypes[plan.buckets[b][0]])))
+        elif plan.hybrid:
+            globals_ = zero_unstack_global(np.asarray(leaf), plan, b)
+            out.append(np.concatenate([np.ravel(g) for g in globals_])
+                       if len(globals_) > 1 else np.ravel(globals_[0]))
         else:
             out.append(jnp.reshape(leaf, (-1,))[:plan.sizes[b]])
     return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
@@ -205,27 +220,55 @@ def zero_from_canonical(canonical: Any,
             f"optimizer-state leaves, this world's template has "
             f"{len(t_leaves)} — was the checkpoint written by a different "
             f"optimizer?")
+    canon_sizes = plan.canonical_sizes()
     out = []
     for c, t, b in zip(c_leaves, t_leaves, ids):
         if b is None:
             out.append(c)
             continue
         flat = np.asarray(c).reshape(-1)
-        if flat.size != plan.sizes[b]:
+        if flat.size != canon_sizes[b]:
             raise ValueError(
                 f"ZeRO shard length mismatch: checkpoint leaf has "
                 f"{flat.size} elements, this world's bucket {b} expects "
-                f"{plan.sizes[b]} — the fusion bucket plan differs "
-                f"(HOROVOD_FUSION_THRESHOLD must match the saving run, "
-                f"and the model must be unchanged)")
-        pad = plan.padded[b] - plan.sizes[b]
-        if pad:
-            flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
-        stacked = flat.reshape(plan.nshards, plan.shard_len(b))
+                f"{canon_sizes[b]} — the fusion bucket plan differs "
+                f"(HOROVOD_FUSION_THRESHOLD and the mesh AXIS NAMES must "
+                f"match the saving run, and the model must be unchanged; "
+                f"dp/tp SIZE reshapes are fine, dropping or adding an "
+                f"axis name changes the spec groups and is not)")
+        if plan.hybrid:
+            # 2-D canonical: split the flat global vector back into the
+            # bucket's global leaves, then re-stack for THIS mesh's
+            # (dp, tp) split.
+            globals_full = [None] * len(plan.shapes)
+            off = 0
+            for j in plan.buckets[b]:
+                n = int(np.prod(plan.global_shapes[j]))
+                globals_full[j] = flat[off:off + n].reshape(
+                    plan.global_shapes[j])
+                off += n
+            stacked = zero_stack_global(globals_full, plan, b)
+        else:
+            pad = plan.padded[b] - plan.sizes[b]
+            if pad:
+                flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+            stacked = flat.reshape(plan.nshards, plan.shard_len(b))
         if isinstance(t, jax.Array):
             stacked = jax.device_put(stacked, t.sharding)
         out.append(stacked)
     return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
+
+
+def _axes_bound(names) -> bool:
+    """True when every named mesh axis is bound in the current trace —
+    the generalization of ``runtime._in_world_trace`` to hybrid meshes."""
+    from .utils.compat import axis_size as _axsz
+    try:
+        for n in ((names,) if isinstance(names, str) else tuple(names)):
+            _axsz(n)
+        return True
+    except Exception:  # noqa: BLE001 — unbound axis raises NameError-ish
+        return False
 
 
 def partition_optimizer(optimizer: optax.GradientTransformation,
@@ -235,7 +278,11 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
                         accum_steps: int = 1,
                         wire_dtype=None,
                         overlap: bool = False,
-                        axis_name: str = AXIS
+                        axis_name: str = AXIS,
+                        mesh=None,
+                        param_specs=None,
+                        scatter_axis: str = "dp",
+                        skip_axes: Tuple[str, ...] = ()
                         ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with ZeRO-1 sharded updates.
 
@@ -269,16 +316,76 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
     therefore the sharded-state layout and checkpoint canonical form —
     never changes); pair it with ``make_train_step(overlap=True)``, which
     supplies the backward-completion order probe.
+
+    ``mesh=`` + ``param_specs=`` switch to the N-D hybrid plane: the
+    optimizer state shards over the mesh's ``scatter_axis`` (dp) for
+    tp-sharded and replicated params alike — the plan groups leaves by
+    their PartitionSpec so each bucket's reduce-scatter runs over ``dp``
+    only, replicated buckets take their tp-side psum on the 1/dp shard,
+    and tp-sharded buckets' stacked state arrays split over BOTH axes
+    (``P(dp, tp)``), so no chip ever materializes another tp rank's
+    state. ``param_specs`` may be the spec tree or a callable
+    ``params -> spec tree``. Pair with ``make_train_step(mesh=,
+    param_specs=)``; env-world (tpurun) hybrid is not supported.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     prescale = None if accum_steps <= 1 else 1.0 / accum_steps
     wire = resolve_wire_dtype(wire_dtype)
+    if mesh is not None and param_specs is None:
+        raise ValueError(
+            "partition_optimizer(mesh=...) requires param_specs= — the "
+            "spec tree is what keys the per-leaf collective plan")
+    if mesh is not None and not average:
+        raise ValueError(
+            "the spec-grouped hybrid plane defines averaging semantics "
+            "via per-group denominators — average=False has no meaning "
+            "there")
 
     def _nshards() -> int:
         return runtime.size() if runtime.is_initialized() else 1
 
+    def _hybrid_init(params):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if runtime.is_initialized() and runtime.world().env_world:
+            raise ValueError(
+                "hybrid (mesh=) ZeRO is single-controller only: the "
+                "env-world plane has no tp axis to shard weights over — "
+                "run without tpurun, one process driving all chips")
+        specs = param_specs(params) if callable(param_specs) \
+            else param_specs
+        n = int(mesh.shape[scatter_axis])
+        plan = plan_zero(params, n, fusion_threshold, specs=specs,
+                         mesh=mesh, scatter_axis=scatter_axis,
+                         skip_axes=skip_axes)
+        leaves = plan.treedef.flatten_up_to(params)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            raise ValueError(
+                "hybrid ZeRO state must be initialized eagerly (the "
+                "stacked shard layout is assembled host-side from the "
+                "global params) — call init outside jit")
+        stacked = []
+        for i in range(len(plan.buckets)):
+            arr = zero_stack_global(leaves, plan, i)
+            stacked.append(jax.device_put(
+                arr, NamedSharding(mesh, zero_stacked_spec(plan, i))))
+        inner = optimizer.init(tuple(stacked))
+        # Commit every inner leaf to the hybrid mesh: shard leaves keep
+        # the stacked layout (dp × the bucket's tp-like axes), scalars
+        # (Adam count) replicate — one device set for jit dispatch AND
+        # for these trees to work as restore templates.
+        ids = _zero_shard_leaf_buckets(inner, plan)
+        ileaves, itd = jax.tree_util.tree_flatten(inner)
+        placed = []
+        for leaf, b in zip(ileaves, ids):
+            sharding = NamedSharding(
+                mesh, P() if b is None else zero_stacked_spec(plan, b))
+            placed.append(jax.device_put(jnp.asarray(leaf), sharding))
+        return ZeroShardedState(inner=itd.unflatten(placed), plan=plan)
+
     def init_fn(params):
+        if mesh is not None:
+            return _hybrid_init(params)
         n = _nshards()
         plan = plan_zero(params, n, fusion_threshold)
         env_world = runtime.is_initialized() and runtime.world().env_world
@@ -333,31 +440,35 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
         finite_out = extra.pop("finite_out", None)
         grad_order = extra.pop("grad_order", None)
         plan = state.plan
-        if plan.nshards > 1 and not runtime._in_world_trace():
+        axis = plan.scatter_axis if plan.scatter_axis is not None \
+            else axis_name
+        needs_trace = plan.nshards > 1 or bool(plan.nonscatter)
+        if needs_trace and not _axes_bound(axis):
             raise ValueError(
                 "ZeRO updates must run inside the compiled step (the "
                 "reduce-scatter/all-gather pair is an in-trace collective "
-                "over the world axis) — build the step with "
-                "make_train_step(zero=True), or use the env-world plane "
+                "over the mesh) — build the step with "
+                "make_train_step(zero=True) (hybrid: make_train_step("
+                "mesh=, param_specs=)), or use the env-world plane "
                 "which drives the exchange from the host")
-        if runtime.is_initialized() and runtime._in_world_trace():
+        if _axes_bound(axis):
             from .utils.compat import axis_size
-            world = int(axis_size(axis_name))
+            world = int(axis_size(axis))
             if world != plan.nshards:
                 raise ValueError(
                     f"optimizer state was partitioned for a world of "
                     f"{plan.nshards} but this step runs over {world} "
-                    f"rank(s) — initialize the state after hvd.init() "
-                    f"(or rebuild it for the current world)")
+                    f"{axis!r} rank(s) — initialize the state after "
+                    f"hvd.init() / on the mesh the step runs over")
         need_finite = finite_out is not None
         emit = zero_emit_order(plan, grad_order) \
             if (overlap or grad_order is not None) else None
         out = fused_reduce_scatter(
-            grads, plan, average=average, axis_name=axis_name,
+            grads, plan, average=average, axis_name=axis,
             prescale=prescale, return_finite=need_finite,
             wire_dtype=wire, emit_order=emit)
         grad_shards, local_finite = out if need_finite else (out, None)
-        p_shards = shard_params(params, plan, axis_name=axis_name)
+        p_shards = shard_params(params, plan, axis_name=axis)
         # The inner state's array leaves are per-device [1, shard_len]
         # blocks of the stacked layout; present the flat shards the same
         # way so elementwise state updates broadcast shape-exactly.
@@ -366,7 +477,7 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
         upd_shards, new_inner = optimizer.update(gs, state.inner, ps)
         flat_upd = [u.reshape(-1) for u in upd_shards]
         gathered = fused_allgather_params(
-            flat_upd, plan, axis_name=axis_name,
+            flat_upd, plan, axis_name=axis,
             and_finite=local_finite if need_finite else None)
         if need_finite:
             updates, all_finite = gathered
@@ -387,6 +498,79 @@ def partition_optimizer(optimizer: optax.GradientTransformation,
     # The env-world plane drives the collectives from the host and needs
     # direct access to the wrapped transformation's shard update.
     update_fn.inner_update = optimizer.update
+    # Hybrid stamps: make_train_step auto-detects the mesh/spec plane from
+    # the optimizer exactly like it auto-detects zero.
+    update_fn.mesh = mesh
+    update_fn.param_specs = param_specs
+    update_fn.scatter_axis = scatter_axis
+    update_fn.hybrid = mesh is not None
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _hybrid_allreduce_optimizer(optimizer, *, mesh, param_specs, skip_axes,
+                                fusion_threshold, accum_steps, wire,
+                                overlap) -> optax.GradientTransformation:
+    """The replicated-update half of the hybrid plane (zero=False):
+    gradients ride the spec-grouped fused psum plan
+    (:func:`~horovod_tpu.ops.fusion.fused_allreduce` with
+    ``reduce_axes=``), the wrapped transformation updates a full replica.
+    State leaves mirror the params, so they are committed to the hybrid
+    mesh with the SAME PartitionSpecs — tp-sharded weights' momenta shard
+    over tp too."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    prescale = None if accum_steps <= 1 else 1.0 / accum_steps
+
+    def _specs_for(params):
+        return param_specs(params) if callable(param_specs) else param_specs
+
+    def init_fn(params):
+        state = optimizer.init(params)
+        specs = _specs_for(params)
+
+        def _place(leaf, spec):
+            if isinstance(leaf, jax.core.Tracer):
+                return leaf
+            return jax.device_put(jnp.asarray(leaf),
+                                  NamedSharding(mesh, spec))
+
+        return optax.tree_map_params(
+            optimizer, lambda s, sp: _place(s, sp), state, specs,
+            transform_non_params=lambda s: _place(s, P()))
+
+    def update_fn(grads, state, params=None, **extra):
+        finite_out = extra.pop("finite_out", None)
+        grad_order = extra.pop("grad_order", None)
+        specs = _specs_for(params if params is not None else grads)
+        spec_leaves = jax.tree_util.tree_flatten(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+        syncs = plan_grad_sync(spec_leaves, mesh, skip_axes=skip_axes)
+        kw = dict(average=True, fusion_threshold=fusion_threshold,
+                  prescale=prescale, wire_dtype=wire,
+                  overlap=overlap, grad_order=grad_order,
+                  reduce_axes=syncs)
+        if finite_out is None:
+            grads = fused_allreduce(grads, **kw)
+        else:
+            grads, all_finite = fused_allreduce(
+                grads, return_finite=True, **kw)
+            finite_out["all_finite"] = all_finite
+        return optimizer.update(grads, state, params, **extra)
+
+    update_fn.accum_steps = accum_steps
+    update_fn.supports_finite_out = True
+    update_fn.wire_dtype = wire_dtype_name(wire)
+    update_fn.overlap = overlap
+    update_fn.supports_grad_order = True
+    update_fn.mesh = mesh
+    update_fn.param_specs = param_specs
+    update_fn.skip_axes = tuple(skip_axes)
+    update_fn.hybrid = True
+    # The step builder derives opt-state PartitionSpecs by mapping the
+    # param specs over the state with optax.tree_map_params — that needs
+    # the WRAPPED transformation (this wrapper's init would device_put
+    # optax's structure-probe placeholders).
+    update_fn.inner_transform = optimizer
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -400,7 +584,10 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          zero: bool = False,
                          wire_dtype=None,
                          overlap: Optional[bool] = None,
-                         axis_name: str = AXIS
+                         axis_name: str = AXIS,
+                         mesh=None,
+                         param_specs=None,
+                         skip_axes: Tuple[str, ...] = ()
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with fused gradient allreduce.
 
@@ -449,6 +636,18 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     bit-identical) and ``overlap``; ``compression=Compression.bf16`` is
     accepted as an alias for ``wire_dtype="bf16"`` here. Sparse gradients
     must be densified (``sparse_as_dense=True``).
+
+    ``mesh=`` + ``param_specs=`` arm the N-D hybrid plane (ISSUE 8): the
+    gradient exchange becomes the spec-grouped collective plan — each
+    leaf psums over exactly the mesh axes it is replicated across
+    (tp-sharded weight grads over ``dp`` only, with the psum-transpose
+    correction folded into the bucket prescale), leaves bucket within
+    their spec group, and with ``zero=True`` the optimizer state shards
+    over ``dp`` for tp-sharded params too (:func:`partition_optimizer`).
+    ``param_specs`` is a PartitionSpec tree mirroring the params (or a
+    callable ``params -> tree``); pair with ``make_train_step(mesh=,
+    param_specs=)``, which auto-detects the plane from this optimizer's
+    stamp.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -457,6 +656,22 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         else _config.wire_dtype_default())
     if overlap is None:
         overlap = _config.overlap_enabled()
+    if mesh is not None:
+        if param_specs is None:
+            raise ValueError(
+                "DistributedOptimizer(mesh=...) requires param_specs= — "
+                "the spec tree keys the per-leaf collective plan")
+        if not average:
+            raise ValueError(
+                "the spec-grouped hybrid plane defines averaging "
+                "semantics via per-group denominators — average=False "
+                "has no meaning there")
+        if sparse_as_dense or (not zero
+                               and compression is not Compression.none):
+            raise ValueError(
+                "the hybrid (mesh=) plane supports dense gradients and "
+                "wire_dtype= only (compression= casts whole leaves "
+                "before bucketing, which the spec-grouped plan replaces)")
 
     if zero:
         if compression is Compression.bf16:
@@ -480,7 +695,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         part = partition_optimizer(
             optimizer, average=average, fusion_threshold=fusion_threshold,
             accum_steps=accum_steps, wire_dtype=wire, overlap=overlap,
-            axis_name=axis_name)
+            axis_name=axis_name, mesh=mesh, param_specs=param_specs,
+            skip_axes=skip_axes)
         if not sparse_as_dense:
             return part
 
@@ -494,7 +710,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
         for attr in ("accum_steps", "supports_finite_out", "zero",
                      "inner_update", "wire_dtype", "overlap",
-                     "supports_grad_order"):
+                     "supports_grad_order", "mesh", "param_specs",
+                     "scatter_axis", "hybrid"):
             setattr(zero_update, attr, getattr(part.update, attr))
         # The env-world plane flattens grads itself (it never enters this
         # wrapper) and consults the stamp to densify before bucketing.
@@ -507,6 +724,12 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             "whole leaves before bucketing while wire_dtype casts each "
             "bucket at the collective (fp32 scales and accumulation) — "
             "pick one (wire_dtype is the recommended form)")
+
+    if mesh is not None:
+        return _hybrid_allreduce_optimizer(
+            optimizer, mesh=mesh, param_specs=param_specs,
+            skip_axes=skip_axes, fusion_threshold=fusion_threshold,
+            accum_steps=accum_steps, wire=wire, overlap=overlap)
 
     def init_fn(params):
         return optimizer.init(params)

@@ -77,7 +77,8 @@ def _leaf_records(tree: Any) -> List[dict]:
     return records
 
 
-def write_manifest(path: str, tree: Any, step: Optional[int] = None) -> str:
+def write_manifest(path: str, tree: Any, step: Optional[int] = None,
+                   extra_meta: Optional[dict] = None) -> str:
     """Write the integrity manifest for the checkpoint at ``path``.
 
     Called by both checkpoint flavors (``trainer.save_checkpoint`` and
@@ -94,6 +95,8 @@ def write_manifest(path: str, tree: Any, step: Optional[int] = None) -> str:
     leaves, not worlds).
     """
     meta: dict = {"format": 1, "leaves": _leaf_records(tree)}
+    if extra_meta:
+        meta.update(extra_meta)
     if step is not None:
         meta["step"] = int(step)
     if runtime.is_initialized():
@@ -250,6 +253,22 @@ def _has_zero_state(tree: Any) -> bool:
         tree, is_leaf=_is_zero_state))
 
 
+def _zero_mesh_meta(tree: Any) -> Optional[dict]:
+    """Mesh layout of the tree's first ZeRO plan (diagnostic metadata for
+    the manifest): shard count plus, on a hybrid mesh, the scatter axis
+    and the nonscatter axis sizes — so a mesh-reshape restore can log
+    exactly what it is re-sharding across. None for ZeRO-free trees."""
+    for l in jax.tree_util.tree_leaves(tree, is_leaf=_is_zero_state):
+        if _is_zero_state(l):
+            meta = {"nshards": int(l.plan.nshards)}
+            if l.plan.hybrid:
+                meta["scatter_axis"] = l.plan.scatter_axis
+                meta["nonscatter"] = {a: int(n)
+                                      for a, n in l.plan.nonscatter}
+            return meta
+    return None
+
+
 def _zero_stays_sharded(x) -> bool:
     """A ZeRO node whose stacked arrays are not fully addressable (a
     jax.distributed world where other processes own part of them) cannot
@@ -322,14 +341,20 @@ def save_sharded(directory: str, step: int, params: Any,
     a marker-bearing step is always verifiable.
 
     ZeRO optimizer state is written in its canonical world-agnostic form
-    (:func:`_canonicalize_zero`: flat unpadded bucket vectors), so the
-    manifest CRCs — and therefore :func:`verify_checkpoint` and the
-    elastic fallback walk — hold across world-size changes, and
-    :func:`restore_sharded` can re-shard onto a different world.
+    (:func:`_canonicalize_zero`: flat unpadded bucket vectors; on hybrid
+    meshes the 2-D form — flat GLOBAL bucket vectors, identical across
+    (dp, tp) reshapes), so the manifest CRCs — and therefore
+    :func:`verify_checkpoint` and the elastic fallback walk — hold across
+    world-size changes AND mesh reshapes, and :func:`restore_sharded` can
+    re-shard onto a different world or mesh. The manifest records the
+    writing plan's mesh layout (``zero_mesh``) so the restore can log the
+    reshape it performs.
     """
     import orbax.checkpoint as ocp
     path = _ckpt_path(directory, step)
-    tree = _canonicalize_zero({"params": params, "opt_state": opt_state})
+    live = {"params": params, "opt_state": opt_state}
+    zero_mesh = _zero_mesh_meta(live)
+    tree = _canonicalize_zero(live)
     if all(not isinstance(l, jax.Array) or l.is_fully_addressable
            for l in jax.tree_util.tree_leaves(tree)):
         # One bulk device→host fetch feeds BOTH the orbax write and the
@@ -351,7 +376,9 @@ def save_sharded(directory: str, step: int, params: Any,
         # Rank 0 owns the shared directory in a jax.distributed world;
         # env-world ranks each own a PRIVATE directory and must manifest
         # their own copy (elastic restore verifies per-rank).
-        write_manifest(path, tree, step=step)
+        write_manifest(path, tree, step=step,
+                       extra_meta={"zero_mesh": zero_mesh}
+                       if zero_mesh else None)
     if (not runtime.is_initialized()
             or runtime.world().controller_rank == 0):
         apply_retention(directory, path, max_to_keep)
@@ -399,13 +426,24 @@ def restore_sharded(directory: str, params_template: Any,
     # checkpoint's format); everything else keeps the template leaf and
     # its sharding.
     canon_template = _canonicalize_zero(template, placeholders=True)
-    if _has_zero_state(template) and runtime.is_initialized():
+    if _has_zero_state(template):
         manifest = read_manifest(path)
         saved_world = manifest.get("world_size") if manifest else None
-        if saved_world is not None and saved_world != runtime.size():
+        if (runtime.is_initialized() and saved_world is not None
+                and saved_world != runtime.size()):
             print(f"[ckpt] re-sharding ZeRO optimizer state: checkpoint "
                   f"written by a world of {saved_world}, restoring into "
                   f"{runtime.size()}", file=sys.stderr, flush=True)
+        saved_zm = manifest.get("zero_mesh") if manifest else None
+        cur_zm = _zero_mesh_meta(template)
+        if saved_zm is not None and cur_zm is not None \
+                and saved_zm != cur_zm:
+            # 2-D canonical form at work: same global bytes, new (dp, tp)
+            # split — e.g. a (dp=4, tp=2) checkpoint restoring at
+            # (dp=2, tp=4).
+            print(f"[ckpt] re-sharding ZeRO optimizer state across mesh "
+                  f"reshape: {saved_zm} -> {cur_zm}",
+                  file=sys.stderr, flush=True)
 
     def _restore_args(x):
         if isinstance(x, jax.Array) or isinstance(x, jax.ShapeDtypeStruct):

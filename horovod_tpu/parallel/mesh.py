@@ -42,15 +42,37 @@ def create_hybrid_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sp: int = 1,
     sizes = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
     total = math.prod(sizes.values())
     if total != len(devs):
+        knobs = {"dp": "dp= (bench.py --mesh, examples --dp)",
+                 "pp": "pp= (examples --pp)",
+                 "ep": "ep= (set n_experts to the ep size)",
+                 "sp": "sp= (examples --sp)",
+                 "tp": "tp= (bench.py --tp/--mesh, examples --tp)"}
+        detail = ", ".join(f"{a}={sizes[a]} via {knobs[a]}" for a in AXES
+                           if sizes[a] != 1) or "all axes at their default 1"
         raise ValueError(
-            f"mesh {sizes} needs {total} devices, have {len(devs)}")
+            f"mesh {sizes} needs {total} devices, have {len(devs)}: the "
+            f"axis sizes ({detail}) must multiply to the visible device "
+            f"count — adjust the knobs above, or the device count "
+            f"(JAX_PLATFORMS / --xla_force_host_platform_device_count), "
+            f"or pass an explicit devices= subset")
     names = tuple(a for a in AXES if sizes[a] > 1) or ("dp",)
     shape = tuple(sizes[a] for a in names)
     return Mesh(np.array(devs).reshape(shape), names)
 
 
 def axis_size(mesh: Mesh, name: str) -> int:
-    return mesh.shape.get(name, 1)
+    """Size of ``name`` on ``mesh``; 1 for a canonical axis the mesh does
+    not carry. A name that is neither on the mesh nor in :data:`AXES`
+    raises — a typo ('dpp') must not silently read as "absent, size 1"
+    and quietly skip a collective."""
+    if name in mesh.shape:
+        return int(mesh.shape[name])
+    if name not in AXES:
+        raise ValueError(
+            f"unknown mesh axis {name!r}: this mesh has "
+            f"{tuple(mesh.axis_names)} and the canonical axis names are "
+            f"{AXES} (absent canonical axes have size 1)")
+    return 1
 
 
 def named_sharding_tree(mesh: Mesh, tree, spec_fn=None):

@@ -305,7 +305,13 @@ def chunked_nll(x, embed, labels, cfg: TransformerConfig):
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
                              optimizer: optax.GradientTransformation,
                              aux_weight: float = 0.01,
-                             wire_dtype=None):
+                             wire_dtype=None,
+                             *,
+                             zero: bool = False,
+                             accum_steps: int = 1,
+                             guard_nonfinite=None,
+                             overlap=None,
+                             fusion_threshold=None):
     """Build (init_state, step): the compiled multi-axis training step.
 
     ``init_state(rng)`` returns (params, opt_state) as global sharded
@@ -313,11 +319,25 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
     returns (params, opt_state, loss). tokens/labels are global
     [B, T] int32, sharded (dp, sp).
 
+    This family is a THIN WRAPPER over the core stack (ISSUE 8): the loss
+    is handed to ``training.make_train_step(mesh=, param_specs=)`` and
+    everything below the loss — spec-grouped fused collectives,
+    ``zero=True`` ZeRO-1 sharding of the optimizer state over ``dp``
+    (tp-sharded params included), ``accum_steps`` microbatch scanning,
+    the ``guard_nonfinite`` bad-step guard (default:
+    ``HVD_GUARD_NONFINITE``), ``overlap`` emission and ``wire_dtype``
+    reduced-precision wire — is the ONE implementation the flax plane
+    runs; the duplicated grad-sync/update logic this file used to carry
+    is gone. On a skipped (non-finite) step the returned loss is 0 and
+    params/opt_state come back bit-unchanged.
+
     ``wire_dtype`` (``"bf16"``/``"fp8"``; see ``docs/performance.md``
     "Overlap & wire formats") runs the data-parallel gradient averages in
-    reduced wire precision with fp32 scales and fp32 result accumulation
-    (:func:`~horovod_tpu.parallel.mesh.grad_sync_by_spec`).
+    reduced wire precision with fp32 scales and fp32 result accumulation.
     """
+    from .. import training
+    from ..optimizer import DistributedOptimizer
+
     axes = _axes(mesh)
     if cfg.n_experts and "ep" in axes \
             and cfg.n_experts != mesh.shape["ep"]:
@@ -333,12 +353,9 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
                    "sp" if "sp" in axes else None)
     specs = param_specs(cfg, mesh)
 
-    def _grad_sync(grads):
-        # Shared spec-driven sync (see parallel/mesh.py): pmean over each
-        # leaf's replicated axes + the tp psum-transpose correction.
-        from .mesh import grad_sync_by_spec
-        return grad_sync_by_spec(grads, specs, axes,
-                                 wire_dtype=wire_dtype)
+    dist_opt = DistributedOptimizer(
+        optimizer, zero=zero, wire_dtype=wire_dtype, overlap=overlap,
+        fusion_threshold=fusion_threshold, mesh=mesh, param_specs=specs)
 
     def _loss_fn(params, tokens, labels):
         if cfg.loss_chunk:
@@ -350,47 +367,37 @@ def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
         loss = jnp.mean(nll) + aux_weight * aux
         return loss
 
-    def _step(params, opt_state, tokens, labels):
-        loss, grads = jax.value_and_grad(_loss_fn)(params, tokens, labels)
-        grads = _grad_sync(grads)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        loss = lax.pmean(loss, tuple(axes))
-        return params, opt_state, loss
+    def _vag(params, batch_stats, tokens, labels, rng):
+        # The core step's value_and_grad contract; the transformer has no
+        # batch statistics and owns its remat (cfg.remat) and rng-free
+        # forward, so stats/logits ride as None.
+        def lf(p):
+            return _loss_fn(p, tokens, labels), (None, None)
+        return jax.value_and_grad(lf, has_aux=True)(params)
 
-    pspecs = specs
-
-    def _opt_specs(opt_state):
-        # Derivable from any opt_state with the right STRUCTURE, so the
-        # checkpoint-restore path (params/opt_state from disk, init_state
-        # never called) works too.
-        return optax.tree_map_params(
-            optimizer, lambda _, s: s, opt_state, pspecs,
-            transform_non_params=lambda _: P())
+    core = training.make_train_step(
+        None, dist_opt, mesh=mesh, param_specs=specs,
+        batch_spec=batch_spec, donate=False, accum_steps=accum_steps,
+        guard_nonfinite=guard_nonfinite, overlap=overlap,
+        _value_and_grad=_vag)
 
     def init_state(rng):
         params = init_params(rng, cfg)
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            params, pspecs, is_leaf=lambda x: isinstance(x, P))
-        opt_state = optimizer.init(params)
-        opt_state = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(jnp.asarray(x),
-                                        NamedSharding(mesh, s)),
-            opt_state, _opt_specs(opt_state),
-            is_leaf=lambda x: isinstance(x, P))
-        return params, opt_state
+            params, specs, is_leaf=lambda x: isinstance(x, P))
+        return params, dist_opt.init(params)
 
-    jitted = {}
+    def _state(params, opt_state):
+        return training.TrainState(step=jnp.zeros((), jnp.int32),
+                                   params=params, opt_state=opt_state,
+                                   batch_stats=None)
 
     def step(params, opt_state, tokens, labels):
-        if "fn" not in jitted:
-            ospecs = _opt_specs(opt_state)
-            jitted["fn"] = jax.jit(jax.shard_map(
-                _step, mesh=mesh,
-                in_specs=(pspecs, ospecs, batch_spec, batch_spec),
-                out_specs=(pspecs, ospecs, P()),
-                check_vma=False))
-        return jitted["fn"](params, opt_state, tokens, labels)
+        st, metrics = core(_state(params, opt_state), (tokens, labels))
+        return st.params, st.opt_state, metrics["loss"]
 
+    # AOT handle (jax .lower convention) for HLO-pinned tests.
+    step.lower = lambda params, opt_state, tokens, labels: core.lower(
+        _state(params, opt_state), (tokens, labels))
     return init_state, step

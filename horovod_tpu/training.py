@@ -232,6 +232,8 @@ def create_train_state(model, rng, sample_input, optimizer,
                        wire_dtype=None,
                        overlap: Optional[bool] = None,
                        has_batch_stats: Optional[bool] = None,
+                       mesh: Optional[jax.sharding.Mesh] = None,
+                       param_specs=None,
                        model_kwargs: Optional[dict] = None) -> Tuple[
                            TrainState, optax.GradientTransformation]:
     """Initialize model + DistributedOptimizer state.
@@ -252,6 +254,15 @@ def create_train_state(model, rng, sample_input, optimizer,
     ``HVD_OVERLAP``) pass through to the ``DistributedOptimizer`` — the
     low-precision wire format and backward-overlapped bucket emission
     (``docs/performance.md`` "Overlap & wire formats").
+
+    ``mesh=`` + ``param_specs=`` build the state for the N-D hybrid
+    plane (``docs/performance.md`` "Hybrid dp×tp"): params are placed as
+    global arrays laid out by the spec tree (``param_specs`` may be a
+    callable ``params -> spec tree``), the optimizer carries the
+    spec-grouped collective plan, and with ``zero=True`` its state
+    shards over the mesh's ``dp`` axis for tp-sharded params too. Build
+    the step with ``make_train_step`` as usual — it auto-detects the
+    plane from the optimizer's stamp.
     """
     from .utils import config as _config
     if zero is None:
@@ -261,6 +272,30 @@ def create_train_state(model, rng, sample_input, optimizer,
     batch_stats = variables.get("batch_stats")
     if has_batch_stats is not None and not has_batch_stats:
         batch_stats = None
+    if param_specs is not None or mesh is not None:
+        if param_specs is None or mesh is None:
+            raise ValueError(
+                "hybrid state needs BOTH mesh= and param_specs= — the "
+                "mesh names the axes the specs refer to")
+        specs = param_specs(params) if callable(param_specs) \
+            else param_specs
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda x: isinstance(x, P))
+        if batch_stats is not None:
+            batch_stats = jax.device_put(
+                batch_stats, NamedSharding(mesh, P()))
+        dist_opt = DistributedOptimizer(
+            optimizer, average=average, fusion_threshold=fusion_threshold,
+            compression=compression, zero=zero, wire_dtype=wire_dtype,
+            overlap=overlap, mesh=mesh, param_specs=specs)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=dist_opt.init(params),
+            batch_stats=batch_stats,
+        )
+        return state, dist_opt
     dist_opt = DistributedOptimizer(
         optimizer, average=average, fusion_threshold=fusion_threshold,
         compression=compression, zero=zero, wire_dtype=wire_dtype,
@@ -299,7 +334,10 @@ def make_train_step(model,
                     remat: Any = False,
                     guard_nonfinite: Optional[bool] = None,
                     zero: Optional[bool] = None,
-                    overlap: Optional[bool] = None):
+                    overlap: Optional[bool] = None,
+                    param_specs=None,
+                    batch_spec=None,
+                    _value_and_grad: Optional[Callable] = None):
     """Build the compiled SPMD train step.
 
     The returned function has signature ``step(state, batch) -> (state,
@@ -361,9 +399,49 @@ def make_train_step(model,
     ZeRO plane bucket membership is pinned by the plan and only emission
     order changes. Composes with ``wire_dtype`` on the optimizer
     (``docs/performance.md`` "Overlap & wire formats").
+
+    ``param_specs`` (with ``mesh`` an N-D hybrid mesh from
+    ``create_hybrid_mesh``) runs the step on the hybrid dp×tp plane: the
+    state's params are global arrays laid out by the spec tree, the
+    gradient exchange is the spec-grouped collective plan (tp-sharded
+    weight grads psum over ``dp`` only; replicated leaves over the full
+    mesh), ZeRO shards the optimizer state over ``dp`` for tp-sharded
+    params too, and ``accum_steps``/``guard_nonfinite``/``overlap``/the
+    optimizer's ``wire_dtype`` all compose unchanged. Auto-detected from
+    a ``DistributedOptimizer(mesh=, param_specs=)`` stamp — build the
+    state with ``create_train_state(mesh=, param_specs=)`` and this knob
+    resolves itself. ``batch_spec`` overrides the batch layout (default:
+    leading axis over ``dp``/``ep``). ``_value_and_grad`` swaps the flax
+    loss builder for a custom ``(params, batch_stats, inputs, labels,
+    rng) -> ((loss, (logits, new_stats)), grads)`` — the hook
+    ``parallel/transformer.py`` re-targets through so both families run
+    ONE step implementation. Single-controller only.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    stamp_mesh = getattr(dist_opt.update, "mesh", None)
+    hybrid = param_specs is not None \
+        or getattr(dist_opt.update, "hybrid", False)
+    if hybrid:
+        if param_specs is None:
+            param_specs = getattr(dist_opt.update, "param_specs", None)
+        if mesh is None:
+            mesh = stamp_mesh
+        if mesh is None or param_specs is None:
+            raise ValueError(
+                "hybrid step needs BOTH mesh= and param_specs= (or a "
+                "DistributedOptimizer(mesh=, param_specs=) whose stamps "
+                "supply them)")
+        if stamp_mesh is not None and mesh is not stamp_mesh:
+            raise ValueError(
+                "make_train_step(mesh=...) differs from the mesh this "
+                "DistributedOptimizer was built for — the collective "
+                "plan is keyed to one mesh; pass the same object")
+        if runtime.is_initialized() and runtime.world().env_world:
+            raise ValueError(
+                "the hybrid dp×tp plane is single-controller only: the "
+                "tpurun env-world has no tp axis for compiled collectives "
+                "to span — run one process driving all chips")
     zero_stamped = getattr(dist_opt.update, "zero", False)
     if zero is None:
         from .utils import config as _config
@@ -412,7 +490,33 @@ def make_train_step(model,
             "transformation has no collectives to overlap (wrap it with "
             "horovod_tpu.DistributedOptimizer(...))")
     mesh = mesh if mesh is not None else runtime.mesh()
-    vag = _build_value_and_grad(model, loss_fn, remat)
+    if _value_and_grad is not None:
+        if remat:
+            raise ValueError(
+                "a custom _value_and_grad owns its own remat policy "
+                "(wrap the loss before differentiating) — "
+                "make_train_step(remat=) only applies to the flax model "
+                "path")
+        vag = _value_and_grad
+    else:
+        vag = _build_value_and_grad(model, loss_fn, remat)
+
+    if hybrid:
+        hybrid_axes = tuple(mesh.axis_names)
+        if batch_spec is None:
+            ba = tuple(a for a in ("dp", "ep") if a in hybrid_axes)
+            batch_spec = P(ba if len(ba) > 1
+                           else (ba[0] if ba else None))
+        # Dropout rng folds the BATCH-plane position (dp/sp/ep) only: tp
+        # ranks replicate the same rows and must draw identical masks or
+        # the activations they exchange would diverge.
+        rng_axes = tuple(
+            a for e in batch_spec if e is not None
+            for a in ((e,) if isinstance(e, str) else e))
+        metric_axes: Any = hybrid_axes
+    else:
+        rng_axes = (axis_name,)
+        metric_axes = axis_name
 
     # Backward-completion probe (overlap mode): one abstract trace per
     # input-shape signature, host-side and OUTSIDE the step trace, so the
@@ -457,10 +561,12 @@ def make_train_step(model,
     def _step(state: TrainState, inputs, labels):
         # Fresh dropout mask per step and per rank: fold the step counter
         # and rank into the key (identical masks every step would starve
-        # the dropped units of gradient for the whole run).
-        step_rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
-            jax.lax.axis_index(axis_name))
+        # the dropped units of gradient for the whole run). On the hybrid
+        # plane only the batch-plane axes fold in (tp ranks share masks).
+        step_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        for _a in rng_axes:
+            step_rng = jax.random.fold_in(
+                step_rng, jax.lax.axis_index(_a))
         if accum_steps == 1:
             (loss, (logits, new_stats)), grads = vag(
                 state.params, state.batch_stats, inputs, labels, step_rng)
@@ -485,10 +591,10 @@ def make_train_step(model,
                 grads, state.opt_state, state.params, **upd_kwargs)
         new_params = optax.apply_updates(state.params, updates)
         new_stats = new_stats if new_stats is not None else state.batch_stats
-        metrics = {"loss": jax.lax.pmean(loss, axis_name)}
+        metrics = {"loss": jax.lax.pmean(loss, metric_axes)}
         if extras is not None:
             metrics.update(jax.tree_util.tree_map(
-                lambda m: jax.lax.pmean(m, axis_name), extras))
+                lambda m: jax.lax.pmean(m, metric_axes), extras))
         if guard_nonfinite:
             # Skip-step select: a scalar where() per leaf, which XLA fuses
             # into the update elementwise ops — params/opt_state/batch_stats
@@ -522,6 +628,53 @@ def make_train_step(model,
             batch_stats=new_stats,
         )
         return new_state, metrics
+
+    if hybrid:
+        # Hybrid plane: one jit per state structure, specs resolved lazily
+        # from the live state (the opt-state layout is only known once the
+        # state exists — same pattern as the 1-D ZeRO plane below).
+        ba0 = batch_spec[0] if len(batch_spec) else None
+        lead_axes = () if ba0 is None else (
+            (ba0,) if isinstance(ba0, str) else tuple(ba0))
+        n_lead = 1
+        for _a in lead_axes:
+            n_lead *= int(mesh.shape[_a])
+        _hy_exec: dict = {}
+
+        def _hy_jitted(state: TrainState):
+            key = (jax.tree_util.tree_structure(state.params),
+                   jax.tree_util.tree_structure(state.opt_state),
+                   state.batch_stats is not None)
+            fn = _hy_exec.get(key)
+            if fn is None:
+                pspecs = param_specs(state.params) \
+                    if callable(param_specs) else param_specs
+                ospecs = _hybrid_opt_specs(dist_opt, state.opt_state,
+                                           pspecs)
+                st_spec = TrainState(step=P(), params=pspecs,
+                                     opt_state=ospecs, batch_stats=P())
+                fn = jax.jit(
+                    lambda s, x, y: jax.shard_map(
+                        _step, mesh=mesh,
+                        in_specs=(st_spec, batch_spec, batch_spec),
+                        out_specs=(st_spec, P()),
+                        check_vma=False,
+                    )(s, x, y),
+                    donate_argnums=(0,) if donate else ())
+                _hy_exec[key] = fn
+            return fn
+
+        def hybrid_step(state: TrainState, batch):
+            inputs, labels = batch
+            if accum_steps > 1:
+                _check_accum_batch(inputs, accum_steps, n_lead)
+            _probe_overlap(state, inputs, labels)
+            return _hy_jitted(state)(state, inputs, labels)
+
+        hybrid_step.lower = lambda state, batch: (
+            _probe_overlap(state, *batch)
+            or _hy_jitted(state).lower(state, *batch))
+        return hybrid_step
 
     def _sharded(state, inputs, labels):
         return jax.shard_map(
@@ -617,6 +770,36 @@ def _zero_state_spec(opt_state, axis_name: str):
     return jax.tree_util.tree_map(
         _one, opt_state,
         is_leaf=lambda x: isinstance(x, ZeroShardedState))
+
+
+def _hybrid_opt_specs(dist_opt, opt_state, pspecs):
+    """PartitionSpec tree for a hybrid-plane optimizer state. ZeRO states
+    spec their stacked leaves by bucket (``P(dp, shard_axes)`` — the
+    leaf→bucket mapping reuses the canonicalization's contiguous-run
+    logic, since two buckets can share a stacked shape with different
+    specs); replicated-update states mirror the PARAM specs leaf-for-leaf
+    (a tp-sharded weight's momentum shards over tp too), with scalar
+    state (Adam's count) replicated."""
+    from .optimizer import ZeroShardedState, _zero_shard_leaf_buckets
+    from .ops.fusion import zero_stacked_spec
+
+    def _is_z(x):
+        return isinstance(x, ZeroShardedState)
+
+    if any(_is_z(l) for l in jax.tree_util.tree_leaves(
+            opt_state, is_leaf=_is_z)):
+        def _one(zs: "ZeroShardedState"):
+            ids = _zero_shard_leaf_buckets(zs.inner, zs.plan)
+            _, td = jax.tree_util.tree_flatten(zs.inner)
+            specs = [P() if b is None else zero_stacked_spec(zs.plan, b)
+                     for b in ids]
+            return ZeroShardedState(inner=td.unflatten(specs),
+                                    plan=zs.plan)
+        return jax.tree_util.tree_map(_one, opt_state, is_leaf=_is_z)
+    inner = getattr(dist_opt.update, "inner_transform", None) or dist_opt
+    return optax.tree_map_params(
+        inner, lambda _, s: s, opt_state, pspecs,
+        transform_non_params=lambda _: P())
 
 
 def _is_env_world(mesh) -> bool:

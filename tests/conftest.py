@@ -25,6 +25,14 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    # The tier-1 CI invocation deselects `-m 'not slow'`; register the
+    # marker so using it is not an unknown-marker warning.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run "
+                   "(multi-minute compiles / hardware-evidence tests)")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _world():
     hvd.init()
