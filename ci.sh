@@ -188,6 +188,113 @@ print(f"NaN smoke OK: skip-step bit-exact, all_reduce count {n_guard} "
       f"unchanged by guard")
 EOF
 
+echo "== live-resize chaos leg: shrink 4 -> 2 in place (quiesce, recommit, re-shard — no restart) =="
+# ISSUE 9 acceptance: resize:shrink=2@step=3 must quiesce at a step
+# boundary, recommit through the two-phase elastic commit, re-shard in
+# place and resume — the log pins quiesce -> recommit -> re-shard and must
+# contain NO relaunch line (resize is not a restart), and the final
+# checksum must match an uninterrupted 2-rank run bit-for-bit (the
+# worker's gradient sums are exact dyadic rationals, invariant to how the
+# world splits them).
+RS_REF=$(mktemp -d); RS_DIR=$(mktemp -d)
+HVD_ELASTIC_DIR="$RS_REF" HVD_TOTAL_STEPS=6 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 2 --cpu \
+  python tests/resize_worker.py 2>&1 | tee /tmp/resize_ref.out
+HVD_FAULT_SPEC=resize:shrink=2@step=3 HVD_ELASTIC_DIR="$RS_DIR" \
+HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=6 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 4 --cpu --restarts 1 \
+  python tests/resize_worker.py 2>&1 | tee /tmp/resize_run.out
+# The no-restart pin is the WORKERS' "resuming ... without restart" line:
+# it is printed by every surviving rank the instant the in-place re-shard
+# completes. (tpurun also prints "resize is not a restart" once its
+# commit-confirmation probe lands, but a drill this short can finish
+# inside the probe window — the worker line is the deterministic truth.)
+for want in "resize: quiesced at step" \
+            "recommitting and canonicalizing" \
+            "re-sharded optimizer state in place onto world 2" \
+            "without restart"; do
+  grep -q "$want" /tmp/resize_run.out || {
+    echo "FAIL: resize log missing \"$want\" — the quiesce protocol did" \
+         "not run" >&2
+    exit 1
+  }
+done
+if grep -q "relaunching" /tmp/resize_run.out; then
+  echo "FAIL: the shrink took the RESTART path — live resize must keep" \
+       "surviving ranks' processes" >&2
+  exit 1
+fi
+RS_REF_SUM=$(grep -o "FINAL [0-9.]*" /tmp/resize_ref.out | sort -u || true)
+RS_RUN_SUM=$(grep -o "FINAL [0-9.]*" /tmp/resize_run.out | sort -u || true)
+if [ -z "$RS_REF_SUM" ] || [ "$RS_REF_SUM" != "$RS_RUN_SUM" ]; then
+  echo "FAIL: live-shrunk run diverges from uninterrupted 2-rank run" >&2
+  echo "  reference: $RS_REF_SUM" >&2
+  echo "  resized:   $RS_RUN_SUM" >&2
+  exit 1
+fi
+rm -rf "$RS_REF" "$RS_DIR"
+
+echo "== live-resize chaos leg: grow 2 -> 4 under --restarts 0 (resize is not a restart) =="
+# The grow leg runs with ZERO restarts budget: if the resize were secretly
+# a relaunch, the launch would fail — finishing at world 4 with the
+# uninterrupted 4-rank checksum proves the joiners were spawned into the
+# LIVE world (state over the wire via elastic.resize_join, no disk).
+RG_REF=$(mktemp -d); RG_DIR=$(mktemp -d)
+HVD_ELASTIC_DIR="$RG_REF" HVD_TOTAL_STEPS=8 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 4 --cpu \
+  python tests/resize_worker.py 2>&1 | tee /tmp/resize_grow_ref.out
+HVD_FAULT_SPEC=resize:grow=2@step=3 HVD_ELASTIC_DIR="$RG_DIR" \
+HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=8 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 2 --cpu --restarts 0 --max-np 4 \
+  python tests/resize_worker.py 2>&1 | tee /tmp/resize_grow.out
+grep -q "joining world 4" /tmp/resize_grow.out || {
+  echo "FAIL: no rank joined the grown world over the wire" >&2
+  exit 1
+}
+RG_N=$(grep -c "FINAL" /tmp/resize_grow.out || true)
+if [ "$RG_N" -ne 4 ]; then
+  echo "FAIL: expected 4 FINAL lines after the grow, got $RG_N" >&2
+  exit 1
+fi
+RG_REF_SUM=$(grep -o "FINAL [0-9.]*" /tmp/resize_grow_ref.out | sort -u || true)
+RG_RUN_SUM=$(grep -o "FINAL [0-9.]*" /tmp/resize_grow.out | sort -u || true)
+if [ -z "$RG_REF_SUM" ] || [ "$RG_REF_SUM" != "$RG_RUN_SUM" ]; then
+  echo "FAIL: live-grown run diverges from uninterrupted 4-rank run" >&2
+  echo "  reference: $RG_REF_SUM" >&2
+  echo "  resized:   $RG_RUN_SUM" >&2
+  exit 1
+fi
+rm -rf "$RG_REF" "$RG_DIR"
+
+echo "== live-resize chaos leg: resize racing a kill -> verified-restore fallback =="
+# A rank SIGKILLed while a resize is in flight: the in-place path must be
+# ABANDONED and the world fail over to the supervised restart, resuming
+# from the quiesce recommit via the verified restore walk.
+RK_DIR=$(mktemp -d)
+HVD_FAULT_SPEC=resize:shrink=2@step=3,rank=1:kill@step=4 \
+HVD_ELASTIC_DIR="$RK_DIR" HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=6 \
+  timeout -k 10 300 \
+  python -m horovod_tpu.launcher -np 4 --cpu --restarts 1 \
+  python tests/resize_worker.py 2>&1 | tee /tmp/resize_race.out
+# (No grep on tpurun's "ABANDONED" line: whether the supervisor had even
+# adopted the pending resize when the kill lands is timing-dependent —
+# the invariant is the recovery itself, pinned below.)
+grep -q "recovery: resumed from committed step" /tmp/resize_race.out || {
+  echo "FAIL: the killed resize never fell back to the verified restore" \
+       "walk" >&2
+  exit 1
+}
+RK_SUM=$(grep -o "FINAL [0-9.]*" /tmp/resize_race.out | sort -u || true)
+if [ "$(echo "$RK_SUM" | wc -l)" -ne 1 ] || [ -z "$RK_SUM" ]; then
+  echo "FAIL: ranks disagree on final params after the raced resize" >&2
+  exit 1
+fi
+rm -rf "$RK_DIR"
+
 echo "== tpurun multi-node smoke (2 simulated hosts x 2 ranks, shared coordinator) =="
 # The mpirun -H host1:2,host2:2 analog (docs/running.md): two launcher
 # invocations on localhost forming one world of 4 over the coordinator.

@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import ctypes
 import os
+import socket
+import struct
 import subprocess
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -28,6 +30,134 @@ from ..exceptions import (FailedPreconditionError, StalledError,
                           TransportError, WorkerFailureError)
 from ..testing import faults as _faults
 from ..utils import config as _config
+
+
+class PendingResize(NamedTuple):
+    """A live resize announced by the coordinator (v7 admin plane)."""
+
+    target_world: int   # new world size the job must quiesce into
+    coord_port: int     # coordinator port reserved for the NEW world
+    generation: int     # monotonically increasing resize counter
+
+
+# ---------------------------------------------------------------------------
+# Admin RPC (v7) — pure-socket client, deliberately ctypes-free so the
+# supervising tpurun (which must not load jax OR build the native core) and
+# one-line operator invocations can speak it. The wire format mirrors
+# coordinator.cc: 8-byte native-order length prefix, then
+# {u8 kResizeRequest, i32 target}; reply {u8 kResizeReply, u8 ok, str msg,
+# i32 world, i32 pending_target, i32 new_port, i32 generation} where str is
+# {i64 len, bytes}.
+# ---------------------------------------------------------------------------
+
+_MSG_RESIZE_REQUEST = 7
+_MSG_RESIZE_REPLY = 8
+
+
+def _admin_rpc(addr: str, target: int, timeout: float) -> dict:
+    import time as _time
+    host, _, port_s = addr.partition(":")
+    port = int(port_s) if port_s else 29521
+    # The timeout is a WALL-CLOCK budget for the whole exchange, not a
+    # per-recv bound — a foreign process that re-bound the polled port
+    # must not be able to park the supervisor by dripping one byte per
+    # second inside a per-recv window.
+    deadline = _time.monotonic() + timeout
+
+    def _recv_exact(s, n, what):
+        buf = b""
+        while len(buf) < n:
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                raise TransportError(
+                    f"admin exchange with {addr} exceeded its {timeout}s "
+                    f"budget while reading the {what}")
+            s.settimeout(min(left, timeout))
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise TransportError(
+                    f"coordinator at {addr} closed the admin connection "
+                    f"while sending the {what}")
+            buf += chunk
+        return buf
+
+    with socket.create_connection((host or "127.0.0.1", port),
+                                  timeout=timeout) as s:
+        body = struct.pack("<Bi", _MSG_RESIZE_REQUEST, int(target))
+        s.sendall(struct.pack("<Q", len(body)) + body)
+        (length,) = struct.unpack("<Q", _recv_exact(s, 8, "length prefix"))
+        if length > 4096:
+            # Mirror the server's admin frame cap.
+            raise TransportError(
+                f"oversized admin reply ({length} bytes) from {addr} — "
+                f"not a horovod_tpu coordinator?")
+        reply = _recv_exact(s, length, "reply frame")
+    # Parse defensively: the reply may come from a foreign process that
+    # re-bound the port, or be truncated — surface the documented
+    # TransportError, never a bare struct.error or garbage field values.
+    try:
+        tag, ok = struct.unpack_from("<BB", reply, 0)
+        if tag != _MSG_RESIZE_REPLY:
+            raise TransportError(
+                f"unexpected admin reply tag {tag} from {addr} (mixed "
+                f"horovod_tpu builds? the admin plane is protocol v7+)")
+        (msg_len,) = struct.unpack_from("<q", reply, 2)
+        off = 10
+        if msg_len < 0 or off + msg_len + 16 > len(reply):
+            raise TransportError(
+                f"malformed admin reply from {addr} (message length "
+                f"{msg_len} does not fit the {len(reply)}-byte frame)")
+        msg = reply[off:off + msg_len].decode(errors="replace")
+        off += msg_len
+        world, pending, new_port, generation = struct.unpack_from(
+            "<iiii", reply, off)
+    except struct.error as e:
+        raise TransportError(
+            f"truncated admin reply from coordinator at {addr}: {e}"
+        ) from None
+    return {"ok": bool(ok), "message": msg, "world": world,
+            "pending_target": pending, "coord_port": new_port,
+            "generation": generation}
+
+
+def resize_status(addr: str, *, timeout: float = 5.0,
+                  supervisor: bool = False) -> dict:
+    """Query the coordinator's world size and pending resize (if any).
+
+    Returns ``{"world": N, "pending_target": K-or-0, "coord_port": P,
+    "generation": G, ...}``. Raises :class:`TransportError`/``OSError``
+    when the coordinator is unreachable (callers that poll — tpurun's
+    supervision loop — treat that as "not ready, retry").
+
+    ``supervisor=True`` marks the query as the SUPERVISING launcher's
+    poll: it releases the coordinator's teardown-handoff linger (the
+    pending-resize triple has reached the party that spawns grow ranks).
+    Operator/observability queries must leave it False."""
+    return _admin_rpc(addr, -1 if supervisor else 0, timeout)
+
+
+def request_resize(addr: str, target_world: int, *,
+                   timeout: float = 10.0) -> dict:
+    """Ask the running world at ``addr`` to resize itself to
+    ``target_world`` ranks — the operator/admin ingress of the live-resize
+    plane (``docs/fault_tolerance.md``). Idempotent for the same target;
+    raises :class:`TransportError` when the coordinator refuses (resize to
+    a different size already pending, target == current size, ...).
+
+    One-liner for operators::
+
+        python -c "from horovod_tpu.coord.client import request_resize; \\
+                   print(request_resize('127.0.0.1:29521', 2))"
+    """
+    if int(target_world) < 1:
+        raise ValueError(
+            f"resize target must be >= 1 rank, got {target_world}")
+    out = _admin_rpc(addr, int(target_world), timeout)
+    if not out["ok"]:
+        raise TransportError(
+            f"coordinator at {addr} refused resize to {target_world}: "
+            f"{out['message']}")
+    return out
 
 _REQ_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2,
               "alltoall": 3, "reducescatter": 4}
@@ -95,6 +225,11 @@ def _build_and_load() -> ctypes.CDLL:
     lib.hvdcoord_coord_mute_acks.argtypes = [ctypes.c_int]
     lib.hvdcoord_aborted.restype = ctypes.c_int
     lib.hvdcoord_aborted.argtypes = []
+    # Live-resize plane (v7).
+    lib.hvdcoord_pending_resize.restype = ctypes.c_int
+    lib.hvdcoord_pending_resize.argtypes = [
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
     return lib
 
 
@@ -333,6 +468,22 @@ class CoordClient:
         """Fault hook (rank 0 only): stop the coordinator's heartbeat-acks
         so every client independently detects a dead coordinator."""
         self._lib.hvdcoord_coord_mute_acks(1 if mute else 0)
+
+    def pending_resize(self) -> Optional["PendingResize"]:
+        """The live resize announced by the coordinator, if one is pending
+        (v7 admin plane): ``(target_world, coord_port, generation)``, or
+        ``None``. One atomic load — cheap enough to poll at every training
+        step boundary (the quiesce ingress of
+        :class:`horovod_tpu.elastic.ResizeCoordinator`)."""
+        t = ctypes.c_int(0)
+        p = ctypes.c_int(0)
+        gen = ctypes.c_int(0)
+        if not self._lib.hvdcoord_pending_resize(
+                ctypes.byref(t), ctypes.byref(p), ctypes.byref(gen)):
+            return None
+        return PendingResize(target_world=int(t.value),
+                             coord_port=int(p.value),
+                             generation=int(gen.value))
 
     def shutdown(self):
         self._lib.hvdcoord_shutdown()

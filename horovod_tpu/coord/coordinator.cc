@@ -118,6 +118,12 @@ enum class RespType : uint8_t {
   // surviving rank so every blocked hvdcoord_wait fails fast with the dead
   // rank's identity (-> Python WorkerFailureError) instead of hanging.
   kAbort = 13,
+  // Pending live resize (v7): pushed to every rank the moment an admin
+  // resize request is accepted (sizes = {target_world, new_coord_port,
+  // generation}); also piggybacked on every heartbeat ack. Purely
+  // advisory — ranks act on it at their next step boundary
+  // (horovod_tpu.elastic.ResizeCoordinator), never mid-collective.
+  kResizeNotice = 14,
 };
 
 // Reduction op for allreduce/reducescatter. The reference supports SUM only
@@ -212,6 +218,16 @@ enum class MsgTag : uint8_t {
   // CheckForStalledTensors only *warns*, mpi_ops.cc:1153-1196).
   kHeartbeat = 5,
   kHeartbeatAck = 6,
+  // Admin plane (v7): an operator (or the supervising tpurun) connects to
+  // the coordinator port AFTER world formation and requests a live resize
+  // of the world — the Elastic-Horovod "host discovery" role, inverted:
+  // instead of the launcher polling a discovery script, the resize intent
+  // is pushed into the running world through the plane that already talks
+  // to every rank. kResizeRequest{target} with target=0 is a pure status
+  // query (world size + pending resize), used by tpurun's supervision
+  // loop to learn when it must spawn new ranks.
+  kResizeRequest = 7,
+  kResizeReply = 8,
 };
 
 // Wire protocol version; bumped on incompatible frame-layout changes. Both
@@ -223,7 +239,11 @@ enum class MsgTag : uint8_t {
 // advertise-address suffix (HOROVOD_RING_ADVERTISE_ADDR).
 // v6: liveness plane — kHeartbeat/kHeartbeatAck frames and the kAbort
 // response (fail-fast worker-failure detection, HVD_HEARTBEAT_TIMEOUT).
-constexpr int32_t kProtocolVersion = 6;
+// v7: live-resize plane — post-formation admin connections
+// (kResizeRequest/kResizeReply), the kResizeNotice push, and the pending-
+// resize payload appended to every kHeartbeatAck (ranks learn of a pending
+// resize at a step boundary with ZERO extra collectives on the hot path).
+constexpr int32_t kProtocolVersion = 7;
 
 // ---------------------------------------------------------------------------
 // Env parsing. atoll/atof would silently truncate ("4M" -> 4) or zero out
@@ -775,6 +795,13 @@ class Coordinator {
     // warn-only stall handling (mpi_ops.cc:1153-1196).
     heartbeat_timeout_ = ParseEnvF64("HVD_HEARTBEAT_TIMEOUT", 30.0);
     if (heartbeat_timeout_ < 0) heartbeat_timeout_ = 0;
+    // Resize generation: how many live resizes this job has been through
+    // (exported to re-formed/new ranks as HVD_RESIZE_GENERATION so the
+    // re-initialized coordinator numbers the NEXT resize correctly and
+    // sync-collective names never collide across resizes).
+    resize_generation_ =
+        static_cast<int32_t>(ParseEnvI64("HVD_RESIZE_GENERATION", 0));
+    if (resize_generation_ < 0) resize_generation_ = 0;
     if (!timeline_path.empty()) timeline_.Open(timeline_path);
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
@@ -793,6 +820,22 @@ class Coordinator {
   }
 
   ~Coordinator() {
+    // Live-resize handoff: when the world tears this plane down to
+    // re-form (rank 0 calls hvdcoord_shutdown mid-resize), an accepted
+    // resize the supervising launcher has NOT yet fetched would vanish
+    // with us — and with it the launcher's only way to learn the new
+    // port / spawn grow ranks. Hold the teardown briefly (bounded; the
+    // launcher polls ~2x/second) until one admin query has seen the
+    // pending triple. Skipped when the serve thread already exited
+    // (abort path) or nothing is pending.
+    if (resize_fetch_pending_.load() && !serve_done_.load()) {
+      double linger = ParseEnvF64("HVD_RESIZE_LINGER", 2.0);
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(linger < 0 ? 0 : linger);
+      while (std::chrono::steady_clock::now() < deadline &&
+             resize_fetch_pending_.load() && !serve_done_.load())
+        std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    }
     shutdown_.store(true);
     if (listen_fd_ >= 0) ::close(listen_fd_);
     if (thread_.joinable()) thread_.join();
@@ -822,8 +865,22 @@ class Coordinator {
     client_fds_.assign(size_, -1);
     int accepted = 0;
     while (accepted < size_ && !shutdown_.load()) {
+      // Poll-before-accept: a blocked accept() is not reliably woken by
+      // closing the listen fd, so a world torn down DURING formation
+      // (e.g. its ranks aborted before all peers connected) must not
+      // wedge the destructor's thread join forever.
+      pollfd lp{listen_fd_, POLLIN, 0};
+      int pn = ::poll(&lp, 1, 100);
+      if (pn < 0 || (lp.revents & (POLLERR | POLLNVAL | POLLHUP))) {
+        serve_done_.store(true);
+        return;
+      }
+      if (pn == 0) continue;  // timeout: re-check shutdown_
       int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) return;  // listen socket closed (shutdown path)
+      if (fd < 0) {  // listen socket closed (shutdown path)
+        serve_done_.store(true);
+        return;
+      }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       // Bound the hello read: a connection that opens and sends nothing (a
@@ -936,7 +993,12 @@ class Coordinator {
     // window expires and the sockets are drained, then plan responses.
     // This bounds per-collective latency at ~tick_ms (the reference's
     // negotiation latency floor) while letting in-flight batches coalesce.
-    std::vector<pollfd> pfds(size_);
+    // One extra poll slot for the listen socket: it stays open after
+    // world formation so ADMIN connections (live-resize requests / status
+    // queries, MsgTag::kResizeRequest) can reach a running job. Stray
+    // connections cost one bounded read and a close — they cannot wedge
+    // or kill the world's coordinator.
+    std::vector<pollfd> pfds(size_ + 1);
     int done_ranks = 0;
     // Liveness bookkeeping starts once the world is fully formed: any
     // frame (request, shutdown, heartbeat) from a rank refreshes its
@@ -948,8 +1010,13 @@ class Coordinator {
     while (!shutdown_.load()) {
       for (int i = 0; i < size_; i++)
         pfds[i] = {client_fds_[i], POLLIN, 0};
+      pfds[size_] = {listen_fd_, POLLIN, 0};
       int n = ::poll(pfds.data(), pfds.size(), /*ms=*/5);
       if (n < 0) break;
+      if (n > 0 && (pfds[size_].revents & POLLIN)) {
+        HandleAdminConnection();
+        n--;
+      }
       if (n > 0) {
         // Quiescence batching: keep ingesting while frames keep arriving
         // within a short grace interval, capped at tick_ms total. A burst
@@ -978,6 +1045,7 @@ class Coordinator {
               // dead rank's identity.
               BroadcastAbort(i, "disconnected without a clean shutdown "
                                 "(process crashed or was killed?)");
+              serve_done_.store(true);
               return;
             }
             last_seen_[i] = std::chrono::steady_clock::now();
@@ -987,6 +1055,14 @@ class Coordinator {
               if (!mute_acks_.load()) {
                 Buf ack;
                 ack.PutU8(static_cast<uint8_t>(MsgTag::kHeartbeatAck));
+                // v7: every ack carries the pending-resize triple (0,0,gen
+                // when none) — ranks learn of a pending resize on the
+                // liveness plane they already pay for, with zero extra
+                // collectives on the training hot path.
+                ack.PutI32(pending_resize_target_);
+                ack.PutI32(pending_resize_port_);
+                ack.PutI32(resize_generation_ +
+                           (pending_resize_target_ ? 1 : 0));
                 SendFrame(client_fds_[i], send_mu_, ack.str());
               }
               continue;
@@ -995,6 +1071,8 @@ class Coordinator {
               done_[i] = true;
               if (++done_ranks == size_) {
                 BroadcastShutdown();
+                ResizeHandoffLinger();
+                serve_done_.store(true);
                 return;
               }
               continue;
@@ -1004,6 +1082,7 @@ class Coordinator {
           }
           for (int i = 0; i < size_; i++)
             pfds[i] = {client_fds_[i], POLLIN, 0};
+          pfds[size_] = {listen_fd_, POLLIN, 0};
           auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
                           deadline - std::chrono::steady_clock::now())
                           .count();
@@ -1012,11 +1091,42 @@ class Coordinator {
                               : 0;
           n = ::poll(pfds.data(), pfds.size(), wait);
           if (n < 0) break;
+          if (n > 0 && (pfds[size_].revents & POLLIN)) {
+            // Admin connection arriving mid-batch: consume it here or the
+            // re-poll would spin on it until the tick deadline.
+            HandleAdminConnection();
+            n--;
+          }
         }
       }
       DrainReady();
       CheckStalls();
-      if (CheckHeartbeats()) return;
+      if (CheckHeartbeats()) {
+        serve_done_.store(true);
+        return;
+      }
+    }
+    serve_done_.store(true);
+  }
+
+  // Clean-shutdown tail of a live resize: the world's ranks all tore
+  // down to re-form, but the supervising launcher may not have fetched
+  // the pending triple yet (its admin poll runs ~2x/second; a fast
+  // quiesce can beat it). Keep answering admin connections briefly so
+  // the handoff cannot be lost — without this, a grow's new ranks would
+  // never be spawned. Bounded hard at 10 s so an unsupervised job still
+  // exits.
+  void ResizeHandoffLinger() {
+    if (!resize_fetch_pending_.load()) return;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(10);
+    while (!shutdown_.load() && resize_fetch_pending_.load() &&
+           std::chrono::steady_clock::now() < deadline) {
+      pollfd lp{listen_fd_, POLLIN, 0};
+      int pn = ::poll(&lp, 1, 50);
+      if (pn < 0 || (lp.revents & (POLLERR | POLLNVAL | POLLHUP)))
+        return;
+      if (pn > 0 && (lp.revents & POLLIN)) HandleAdminConnection();
     }
   }
 
@@ -1580,6 +1690,177 @@ class Coordinator {
       if (client_fds_[r] >= 0) SendFrame(client_fds_[r], send_mu_, body);
   }
 
+  // -- admin plane (v7): live-resize ingress -------------------------------
+  // One bounded request/reply exchange per connection, handled inline on
+  // the serve thread: accept, read ONE frame under a short timeout, reply,
+  // close. A resize request records the pending target and pushes a
+  // kResizeNotice to every rank; ranks quiesce at their next step boundary
+  // (horovod_tpu.elastic.ResizeCoordinator) — the coordinator itself never
+  // interrupts in-flight collectives.
+
+  // Reserve a port for the NEW world's coordinator: bind an ephemeral
+  // socket, record its port, close it. The standard free-port probe (same
+  // race tolerance as the launcher's): the port is handed to every rank in
+  // the notice, and the re-formed rank 0 binds it within the connect
+  // budget of the others.
+  static int32_t ProbeFreePort() {
+    int s = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (s < 0) return 0;
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_addr.s_addr = htonl(INADDR_ANY);
+    a.sin_port = 0;
+    int32_t port = 0;
+    socklen_t alen = sizeof(a);
+    if (::bind(s, reinterpret_cast<sockaddr*>(&a), sizeof(a)) == 0 &&
+        getsockname(s, reinterpret_cast<sockaddr*>(&a), &alen) == 0)
+      port = ntohs(a.sin_port);
+    ::close(s);
+    return port;
+  }
+
+  void BroadcastResizeNotice() {
+    Response resp;
+    resp.type = RespType::kResizeNotice;
+    resp.name = "__resize__";
+    resp.sizes = {pending_resize_target_, pending_resize_port_,
+                  resize_generation_ + 1};
+    std::string body = EncodeResponse(resp);
+    for (int r = 0; r < size_; r++)
+      if (client_fds_[r] >= 0 && !done_.empty() && !done_[r])
+        SendFrame(client_fds_[r], send_mu_, body);
+  }
+
+  // Admin requests are a few bytes; anything bigger is not ours. The cap
+  // keeps a hostile length prefix from allocating kMaxFrameBytes on the
+  // training host (RecvFrame's general bound exists for tensor payloads).
+  static constexpr uint64_t kMaxAdminFrameBytes = 4096;
+
+  // Bounded-WALL-CLOCK read: SO_RCVTIMEO only bounds each recv, so a
+  // drip-feeding client (1 byte/second) could otherwise park the serve
+  // thread for minutes and starve heartbeat acks into a world abort.
+  static bool RecvAllDeadline(int fd, void* p, size_t n,
+                              std::chrono::steady_clock::time_point dl) {
+    size_t off = 0;
+    while (off < n) {
+      if (std::chrono::steady_clock::now() >= dl) return false;
+      ssize_t r = ::recv(fd, reinterpret_cast<char*>(p) + off, n - off, 0);
+      if (r <= 0) return false;  // EOF, error, or SO_RCVTIMEO tick
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool RecvAdminFrame(int fd, std::string* body) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(2);
+    uint64_t len;
+    if (!RecvAllDeadline(fd, &len, 8, deadline)) return false;
+    if (len > kMaxAdminFrameBytes) return false;
+    body->resize(len);
+    return len == 0 || RecvAllDeadline(fd, &(*body)[0], len, deadline);
+  }
+
+  void HandleAdminConnection() {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    // Handled inline on the serve thread: keep the stall window a
+    // connection can inflict small (a held-open probe costs one second,
+    // not five — this port shares the hello port's trusted-cluster
+    // model, but a stray health checker must not starve heartbeat acks
+    // into an HVD_HEARTBEAT_TIMEOUT abort).
+    timeval admin_timeout{/*tv_sec=*/1, /*tv_usec=*/0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &admin_timeout,
+               sizeof(admin_timeout));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &admin_timeout,
+               sizeof(admin_timeout));
+    std::string body;
+    bool ok = false;
+    bool accepted_now = false;
+    bool supervisor_fetch = false;
+    std::string msg;
+    if (!RecvAdminFrame(fd, &body) || body.size() < 5 ||
+        static_cast<MsgTag>(body[0]) != MsgTag::kResizeRequest) {
+      // Port scanner / health probe / mixed-build admin: close without
+      // reply beyond the error frame; the world is unaffected.
+      msg = "malformed admin frame (expected kResizeRequest)";
+    } else {
+      Reader rd(body);
+      rd.GetU8();  // tag
+      int32_t target = rd.GetI32();
+      // target 0 = anyone's status query; -1 = the SUPERVISING launcher's
+      // status poll — only the latter releases the teardown-handoff
+      // linger (a third-party operator's query must not consume the
+      // launcher's one chance to learn the grow spawns).
+      if (target == 0 || target == -1) {
+        ok = true;
+        supervisor_fetch = target == -1;
+      } else if (target < 0) {
+        std::ostringstream o;
+        o << "invalid resize target " << target;
+        msg = o.str();
+      } else if (target == 1 && size_ > 1) {
+        msg = "resizing a multi-process world to a single rank is not "
+              "supported (the coordination plane needs >= 2 ranks); "
+              "relaunch with -np 1 instead (the canonical checkpoint "
+              "form restores at any world size)";
+      } else if (target == size_ && pending_resize_target_ == 0) {
+        std::ostringstream o;
+        o << "world is already size " << size_ << "; nothing to resize";
+        msg = o.str();
+      } else if (pending_resize_target_ != 0) {
+        if (target == pending_resize_target_) {
+          ok = true;  // idempotent re-request of the same resize
+        } else {
+          std::ostringstream o;
+          o << "resize to " << pending_resize_target_
+            << " already pending (generation " << resize_generation_ + 1
+            << "); the world must quiesce and re-form before another "
+            << "resize can be requested";
+          msg = o.str();
+        }
+      } else {
+        int32_t port = ProbeFreePort();
+        if (port == 0) {
+          msg = "could not reserve a coordinator port for the new world";
+        } else {
+          pending_resize_target_ = target;
+          pending_resize_port_ = port;
+          ok = true;
+          accepted_now = true;
+          // The supervising launcher must see this pending resize at
+          // least once (its status poll, or a later idempotent
+          // re-request) before the old plane may die — see
+          // ResizeHandoffLinger.
+          resize_fetch_pending_.store(true);
+          fprintf(stderr,
+                  "hvdcoord: live resize requested: world %d -> %d "
+                  "(generation %d, new coordinator port %d); notifying "
+                  "ranks — they quiesce at their next step boundary\n",
+                  size_, target, resize_generation_ + 1, port);
+          BroadcastResizeNotice();
+        }
+      }
+    }
+    Buf reply;
+    reply.PutU8(static_cast<uint8_t>(MsgTag::kResizeReply));
+    reply.PutU8(ok ? 1 : 0);
+    reply.PutStr(msg);
+    reply.PutI32(size_);
+    reply.PutI32(pending_resize_target_);
+    reply.PutI32(pending_resize_port_);
+    reply.PutI32(resize_generation_ + (pending_resize_target_ ? 1 : 0));
+    bool sent = SendFrame(fd, send_mu_, reply.str());
+    ::close(fd);
+    // Only the SUPERVISOR's status poll (target = -1) releases the
+    // teardown linger: it is the party that must learn the triple to
+    // spawn grow ranks. Operator queries and the accepting request pass
+    // through without consuming the handoff.
+    if (sent && ok && pending_resize_target_ && !accepted_now
+        && supervisor_fetch)
+      resize_fetch_pending_.store(false);
+  }
+
   // Liveness sweep: a rank (not cleanly shut down) whose last frame is
   // older than HVD_HEARTBEAT_TIMEOUT is dead or wedged — abort. Returns
   // true when the world was aborted (the serve loop must exit).
@@ -1652,6 +1933,15 @@ class Coordinator {
   double stall_secs_;
   int tick_ms_ = 5;
   double heartbeat_timeout_ = 30.0;
+  // Pending live resize (admin plane, v7). Written and read on the serve
+  // thread only (admin connections are handled inline in the tick loop);
+  // the fetch/serve-done flags are additionally read by the destructor
+  // (teardown-linger handoff) and are atomic.
+  int32_t pending_resize_target_ = 0;  // 0 = none
+  int32_t pending_resize_port_ = 0;    // coordinator port for the NEW world
+  int32_t resize_generation_ = 0;
+  std::atomic<bool> resize_fetch_pending_{false};
+  std::atomic<bool> serve_done_{false};
   std::atomic<bool> mute_acks_{false};
   std::vector<std::chrono::steady_clock::time_point> last_seen_;
   std::vector<bool> done_;
@@ -1978,6 +2268,18 @@ class Client {
   // heartbeat-timeout path deterministically (a kill also closes the
   // socket, which trips the faster disconnect path instead).
   void set_heartbeat_mute(bool m) { hb_mute_.store(m); }
+
+  // Pending live resize, if any: returns true and fills the triple when a
+  // kResizeNotice (or ack piggyback) announced one. One relaxed atomic
+  // load per call — cheap enough for every step boundary.
+  bool pending_resize(int32_t* target, int32_t* port, int32_t* gen) {
+    int32_t t = pending_resize_target_.load();
+    if (t <= 0) return false;
+    if (target) *target = t;
+    if (port) *port = pending_resize_port_.load();
+    if (gen) *gen = pending_resize_gen_.load();
+    return true;
+  }
 
  private:
   static int64_t NowMs() {
@@ -2413,11 +2715,26 @@ class Client {
       MsgTag tag = static_cast<MsgTag>(rd.GetU8());
       if (tag == MsgTag::kHeartbeatAck) {
         last_ack_ms_.store(NowMs());
+        // v7 acks carry the pending-resize triple; reading it here means
+        // the training loop's step-boundary poll is one atomic load.
+        if (body.size() >= 13) {
+          int32_t target = rd.GetI32();
+          int32_t port = rd.GetI32();
+          int32_t gen = rd.GetI32();
+          if (target > 0) SetPendingResize(target, port, gen);
+        }
         continue;
       }
       if (tag != MsgTag::kResponse) break;
       Response resp = DecodeResponse(rd);
       if (resp.type == RespType::kShutdown) break;
+      if (resp.type == RespType::kResizeNotice) {
+        if (resp.sizes.size() >= 3)
+          SetPendingResize(static_cast<int32_t>(resp.sizes[0]),
+                           static_cast<int32_t>(resp.sizes[1]),
+                           static_cast<int32_t>(resp.sizes[2]));
+        continue;
+      }
       if (resp.type == RespType::kAbort) {
         // World aborted (a rank died / went silent). Drop the ring
         // stashes — their plans will never arrive — and fail every
@@ -2601,6 +2918,18 @@ class Client {
   std::thread hb_thread_;
   std::atomic<bool> hb_mute_{false};
   std::atomic<int64_t> last_ack_ms_{0};
+  // Pending live resize announced by the coordinator (v7). Port/gen are
+  // written before target (the readiness flag), so a reader that sees the
+  // target also sees its port/generation.
+  std::atomic<int32_t> pending_resize_target_{0};
+  std::atomic<int32_t> pending_resize_port_{0};
+  std::atomic<int32_t> pending_resize_gen_{0};
+
+  void SetPendingResize(int32_t target, int32_t port, int32_t gen) {
+    pending_resize_port_.store(port);
+    pending_resize_gen_.store(gen);
+    pending_resize_target_.store(target);
+  }
   int peer_listen_fd_ = -1;
   int peer_port_ = 0;
   // Full-duplex data-plane socket per peer rank (-1 = not established).
@@ -2850,6 +3179,21 @@ void hvdcoord_coord_mute_acks(int mute) {
 int hvdcoord_aborted() {
   using namespace hvdcoord;
   return (g()->client && g()->client->aborted()) ? 1 : 0;
+}
+
+// Pending live resize announced over the v7 admin plane: returns 1 and
+// fills {target world, new coordinator port, generation} when one is
+// pending, 0 otherwise. One atomic load — called at every training step
+// boundary by horovod_tpu.elastic.ResizeCoordinator.
+int hvdcoord_pending_resize(int* target, int* port, int* generation) {
+  using namespace hvdcoord;
+  if (!g()->client) return 0;
+  int32_t t = 0, p = 0, gen = 0;
+  if (!g()->client->pending_resize(&t, &p, &gen)) return 0;
+  if (target) *target = t;
+  if (port) *port = p;
+  if (generation) *generation = gen;
+  return 1;
 }
 
 void hvdcoord_shutdown() {

@@ -41,6 +41,7 @@ Usage (the whole loop re-runs after a supervised restart)::
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 from typing import Any, Callable, Optional
@@ -50,6 +51,12 @@ from .exceptions import (CheckpointCorruptError, StalledError,
                          TransportError, WorkerFailureError)
 
 RECOVERABLE = (WorkerFailureError, StalledError, TransportError)
+
+
+def _log(msg: str) -> None:
+    """Operator-facing INFO line (stdout, flushed — the same channel the
+    launcher and fault drills use, so chaos-test greps see one stream)."""
+    print(f"[elastic] {msg}", flush=True)
 
 
 def restart_epoch() -> int:
@@ -323,6 +330,669 @@ class ElasticState:
             verify=force_verify or step not in self._verified_steps)
 
 
+# ---------------------------------------------------------------------------
+# Live elastic resize — grow/shrink the world in place, without a restart.
+#
+# The standard elastic-training shape (Horovod Elastic / TorchElastic)
+# rebuilt on this framework's own planes: the resize intent arrives through
+# the coordinator's v7 admin plane (operator RPC, or tpurun translating
+# SIGUSR1/SIGUSR2 spot-preemption signals) or the deterministic fault
+# injector (``resize:*`` drills); ranks learn of it at a STEP BOUNDARY from
+# a one-atomic-load poll (the notice rides the heartbeat/ack plane — zero
+# extra collectives on the hot path), agree on a quiesce step, finish the
+# in-flight step, commit through the existing two-phase ElasticState
+# commit, canonicalize ZeRO state host-side
+# (:func:`~horovod_tpu.optimizer.zero_to_canonical` — the same
+# world-agnostic form the checkpoints use), re-form the world (mesh
+# re-init in place; the supervising tpurun spawns/reaps processes in the
+# env-world case) and re-shard the optimizer state onto the new world via
+# :func:`~horovod_tpu.optimizer.zero_from_canonical` — surviving ranks
+# never touch disk for state they already hold; only grow-joined ranks
+# receive the canonical bytes (over the wire, from rank 0). Seconds of
+# pause + one recompile instead of minutes of full restart.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResizeRequest:
+    """A pending live resize, as observed at a step boundary."""
+
+    target_world: int
+    generation: int                   # monotonic resize counter
+    coord_port: Optional[int] = None  # env-world: NEW world's coordinator port
+    quiesce_step: Optional[int] = None  # agreed world-wide stop step
+
+
+@dataclasses.dataclass
+class Rebuilt:
+    """What the caller's ``rebuild(new_world)`` hook returns: fresh
+    world-correct TEMPLATES (structure + sharding; values are overwritten
+    by the in-place re-shard) plus whatever the training loop needs to
+    continue — typically the re-jitted train step."""
+
+    params: Any
+    opt_state: Any = None
+    train_step: Any = None
+    extra: Any = None
+
+
+_RESIZE_UNSUPPORTED_WORLD = (
+    "live resize is supported for tpurun env-worlds and "
+    "single-controller worlds; a jax.distributed multi-process "
+    "world cannot re-form its global runtime in place — use "
+    "tpurun --restarts with the world-agnostic canonical "
+    "checkpoint instead")
+
+
+def _normalize_rebuilt(out) -> Rebuilt:
+    if isinstance(out, Rebuilt):
+        return out
+    if isinstance(out, tuple):
+        return Rebuilt(*out)
+    return Rebuilt(params=out)
+
+
+def resize_generation() -> int:
+    """How many live resizes this process's world has been through
+    (``HVD_RESIZE_GENERATION``; set by tpurun on grow-spawned ranks and by
+    the in-place re-form on surviving ranks)."""
+    try:
+        return int(os.environ.get("HVD_RESIZE_GENERATION", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _is_zero_node(x) -> bool:
+    from .optimizer import ZeroShardedState
+    return isinstance(x, ZeroShardedState)
+
+
+def _host_params(params):
+    import jax
+    import numpy as np
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+def _env_local_buckets(zs):
+    """Map env-world LOCAL-shard leaves (``[1, shard_len]`` — one
+    independent process per rank holds only its own row of the stacked
+    layout) to their buckets. The env-world analog of
+    ``optimizer._zero_shard_leaf_buckets``, which deliberately maps only
+    the full stacked layout (the checkpoint flows rely on local-shard
+    states canonicalizing as a no-op); the live-resize path is the one
+    place local shards must be identified, gathered and re-sliced."""
+    import jax
+    import numpy as np
+    plan = zs.plan
+    local = [(1, plan.shard_len(b)) for b in range(len(plan.buckets))]
+    nb = len(local)
+    out, run = [], 0
+    for leaf in jax.tree_util.tree_leaves(zs.inner):
+        shape = tuple(np.shape(leaf))
+        if nb and shape == local[run]:
+            out.append(run)
+            run = (run + 1) % nb
+        elif nb and shape == local[0]:
+            out.append(0)
+            run = 1 % nb
+        else:
+            out.append(None)
+            run = 0
+    return out
+
+
+def _zs_is_local(zs) -> bool:
+    """Whether a ZeRO node is in the env-world local-shard layout (holds
+    only this rank's ``[1, shard_len]`` rows of a ``nshards > 1`` plan)."""
+    import jax
+    import numpy as np
+    if zs.plan.nshards <= 1:
+        return False
+    for leaf, b in zip(jax.tree_util.tree_leaves(zs.inner),
+                       _env_local_buckets(zs)):
+        if b is not None and np.shape(leaf)[0] == 1:
+            return True
+    return False
+
+
+def _canonicalize_opt(opt_state, *, env_world: bool, generation: int,
+                      placeholders: bool = False):
+    """Host-side, world-agnostic copy of an optimizer state: ZeRO nodes
+    become their canonical (flat, unpadded) form, everything else moves to
+    host numpy. In an env-world, each rank holds only its own ``[1, L]``
+    physical shard, so canonicalizing first ALL-GATHERS the stacked shards
+    over the host plane (retiring ranks contribute their shard before they
+    exit — the canonical deltas ride the wire, never the disk).
+    ``placeholders=True`` emits canonical-SHAPED zero stand-ins (a
+    grow-joiner's side of the state broadcast), sized from the plan alone
+    so they work for any physical layout."""
+    import jax
+    import numpy as np
+    if opt_state is None:
+        return None
+    from .optimizer import ZeroShardedState, zero_to_canonical
+
+    def _gather_env_shards(zs: "ZeroShardedState") -> "ZeroShardedState":
+        from .ops import collectives as C
+        import jax.numpy as jnp
+        ids = _env_local_buckets(zs)
+        leaves, treedef = jax.tree_util.tree_flatten(zs.inner)
+        out = []
+        for i, (leaf, b) in enumerate(zip(leaves, ids)):
+            if b is None:
+                out.append(np.asarray(leaf))
+                continue
+            # [1, shard_len] local slice -> [nshards, shard_len] stacked.
+            out.append(np.asarray(C.allgather(
+                jnp.asarray(leaf), name=f"resize{generation}_zg{i}")))
+        return ZeroShardedState(inner=treedef.unflatten(out), plan=zs.plan)
+
+    def _canon_placeholders(zs: "ZeroShardedState") -> "ZeroShardedState":
+        # Canonical-shaped stand-ins built from the PLAN alone
+        # (zero_to_canonical's placeholders only cover the stacked
+        # layout — its bucket mapper deliberately ignores local-shard
+        # leaves, which a grow-joiner's env-world template has).
+        from .optimizer import _zero_shard_leaf_buckets
+        plan = zs.plan
+        ids = _env_local_buckets(zs) if _zs_is_local(zs) \
+            else _zero_shard_leaf_buckets(zs.inner, plan)
+        leaves, treedef = jax.tree_util.tree_flatten(zs.inner)
+        canon_sizes = plan.canonical_sizes()
+        out = [np.zeros((canon_sizes[b],),
+                        np.dtype(plan.dtypes[plan.buckets[b][0]]))
+               if b is not None else np.asarray(leaf)
+               for leaf, b in zip(leaves, ids)]
+        return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
+
+    def _one(x):
+        if isinstance(x, ZeroShardedState):
+            if placeholders:
+                return _canon_placeholders(x)
+            if env_world and _zs_is_local(x):
+                x = _gather_env_shards(x)
+            canon = zero_to_canonical(x)
+            return ZeroShardedState(
+                inner=jax.tree_util.tree_map(np.asarray, canon.inner),
+                plan=canon.plan)
+        return np.asarray(x) if hasattr(x, "dtype") else x
+
+    return jax.tree_util.tree_map(_one, opt_state, is_leaf=_is_zero_node)
+
+
+def _env_from_canonical(canon, template_zs):
+    """Re-shard a canonical (flat, unpadded) ZeRO state onto an env-world
+    LOCAL-shard template: pad + re-stack for the template plan's world,
+    then keep only this rank's ``[1, shard_len]`` row — each host
+    materializes 1/N of the state, never the whole stack."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from .optimizer import ZeroShardedState
+    plan = template_zs.plan
+    ids = _env_local_buckets(template_zs)
+    t_leaves, treedef = jax.tree_util.tree_flatten(template_zs.inner)
+    c_leaves = jax.tree_util.tree_leaves(canon)
+    if len(c_leaves) != len(t_leaves):
+        raise ValueError(
+            f"ZeRO state mismatch: canonical state has {len(c_leaves)} "
+            f"optimizer-state leaves, this world's template has "
+            f"{len(t_leaves)} — was the state written by a different "
+            f"optimizer?")
+    r = runtime.world().controller_rank if runtime.is_initialized() else 0
+    canon_sizes = plan.canonical_sizes()
+    out = []
+    for c, t, b in zip(c_leaves, t_leaves, ids):
+        if b is None:
+            out.append(jnp.asarray(c))
+            continue
+        flat = np.asarray(c).reshape(-1)
+        if flat.size != canon_sizes[b]:
+            raise ValueError(
+                f"ZeRO shard length mismatch: canonical leaf has "
+                f"{flat.size} elements, this world's bucket {b} expects "
+                f"{canon_sizes[b]} — the fusion bucket plan differs "
+                f"(HOROVOD_FUSION_THRESHOLD must match and the model must "
+                f"be unchanged across the resize)")
+        pad = plan.padded[b] - plan.sizes[b]
+        if pad:
+            flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+        row = flat.reshape(plan.nshards, plan.shard_len(b))[r:r + 1]
+        out.append(jnp.asarray(row))
+    return ZeroShardedState(inner=treedef.unflatten(out), plan=plan)
+
+
+def _place_params(host_params, template):
+    """Host values onto the template's shardings (the new world's layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _one(t, h):
+        if isinstance(t, jax.Array):
+            return jax.device_put(h, t.sharding)
+        return jnp.asarray(h)
+
+    return jax.tree_util.tree_map(_one, template, host_params)
+
+
+def _reshard_opt(host_opt, template_opt):
+    """Re-shard the canonical host optimizer state onto the new world's
+    templates: ZeRO nodes via :func:`zero_from_canonical` (which pads,
+    re-stacks and places per the template plan — including the env-world
+    own-row slice), plain leaves via device placement."""
+    import jax
+    import jax.numpy as jnp
+    if template_opt is None:
+        return None
+    from .optimizer import ZeroShardedState, zero_from_canonical
+
+    def _one(t, h):
+        if isinstance(t, ZeroShardedState):
+            canon = h.inner if isinstance(h, ZeroShardedState) else h
+            if _zs_is_local(t):
+                return _env_from_canonical(canon, t)
+            return zero_from_canonical(canon, t)
+        if isinstance(t, jax.Array):
+            return jax.device_put(h, t.sharding)
+        return jnp.asarray(h) if hasattr(t, "dtype") else h
+
+    return jax.tree_util.tree_map(_one, template_opt, host_opt,
+                                  is_leaf=_is_zero_node)
+
+
+def _sync_state_over_plane(step: int, host_params, host_opt,
+                           generation: int):
+    """Broadcast (step, params, canonical opt) from new-world rank 0 over
+    the host coordination plane — how grow-joined ranks receive the
+    in-flight training state without any rank touching disk. Every rank of
+    the NEW world participates (broadcast semantics); survivors already
+    hold the bytes, joiners present canonical-shaped placeholders.
+    Returns the synced (step, host_params, host_opt)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from .ops import collectives as C
+
+    header = C.broadcast_object(
+        {"step": int(step)}, root_rank=0,
+        name=f"resize{generation}_hdr")
+    leaves, treedef = jax.tree_util.tree_flatten(host_params)
+    synced = [np.asarray(C.broadcast(jnp.asarray(l), root_rank=0,
+                                     name=f"resize{generation}_p{i}"))
+              for i, l in enumerate(leaves)]
+    host_params = treedef.unflatten(synced)
+    if host_opt is not None:
+        o_leaves, o_treedef = jax.tree_util.tree_flatten(host_opt)
+        o_synced = [np.asarray(C.broadcast(jnp.asarray(l), root_rank=0,
+                                           name=f"resize{generation}_o{i}"))
+                    for i, l in enumerate(o_leaves)]
+        host_opt = o_treedef.unflatten(o_synced)
+    return int(header["step"]), host_params, host_opt
+
+
+class ResizeCoordinator:
+    """Step-boundary ingress + quiesce protocol of the live-resize plane.
+
+    Usage (the elastic while-loop; ``Trainer(resize=...)`` wires the same
+    calls into its fit loop)::
+
+        rc = elastic.ResizeCoordinator(state, rebuild=rebuild)
+        while state.step < TOTAL:
+            ...train one step...
+            state.advance()
+            rebuilt = rc.step_boundary(state.step)
+            if rebuilt is not None:          # world was just resized
+                train_step = rebuilt.train_step or train_step
+
+    ``rebuild(new_world)`` runs AFTER the world re-forms and must return
+    fresh world-correct templates (:class:`Rebuilt`, or a
+    ``(params, opt_state[, train_step[, extra]])`` tuple) — e.g. re-run
+    ``create_train_state`` / ``partition_optimizer`` init and
+    ``make_train_step`` at the new size. Values are then overwritten in
+    place from the quiesced state; only the layout comes from the rebuild.
+    With ``rebuild=None`` the existing host values are re-materialized
+    as-is (enough for replicated env-world states; ZeRO states REQUIRE a
+    rebuild — their physical layout is world-shaped).
+
+    The poll is one atomic load; the quiesce-step agreement (one tiny MAX
+    allreduce over the host plane, async-submitted so it never blocks a
+    rank that observed the notice earlier than its peers) runs only once a
+    resize is actually pending — the training hot path pays nothing.
+    """
+
+    def __init__(self, state: ElasticState, *,
+                 rebuild: Optional[Callable[[int], Any]] = None,
+                 devices_fn: Optional[Callable[[int], Any]] = None):
+        self.state = state
+        self.rebuild = rebuild
+        # Single-controller device picker for the new world (defaults to
+        # the first ``target`` of jax.devices()); hybrid-mesh callers pick
+        # their own device grid inside ``rebuild`` instead.
+        self.devices_fn = devices_fn
+        self._pending: Optional[ResizeRequest] = None
+        self._proposal = None        # in-flight quiesce-agreement handle
+        self._proposed_at: Optional[int] = None
+        self._local_request: Optional[int] = None
+        # A fault-drill target whose admin RPC failed transiently: the
+        # fault clause fires once, so the RETRY must be carried here or
+        # the drill would be silently dropped.
+        self._drill_retry: Optional[int] = None
+        self.resizes_completed = 0
+
+    # -- programmatic ingress (tests, notebooks, schedulers) ---------------
+    def request(self, target_world: int) -> None:
+        """Request a live resize from inside the job: env-worlds route it
+        through the coordinator's admin RPC (the same path an operator's
+        ``request_resize`` takes), single-controller worlds record it
+        locally and quiesce at the next step boundary."""
+        if target_world < 1:
+            raise ValueError(
+                f"resize target must be >= 1 rank, got {target_world}")
+        w = runtime.world()
+        if w.env_world and w.coord is not None:
+            from .coord.client import request_resize
+            from .utils import config as _config
+            request_resize(_config.coordinator_address(), target_world)
+            return
+        if w.process_count > 1:
+            raise ValueError(_RESIZE_UNSUPPORTED_WORLD)
+        if target_world == w.size:
+            _log(f"resize request ignored: world is already size "
+                 f"{w.size}")
+            return
+        self._local_request = int(target_world)
+
+    # -- step-boundary protocol --------------------------------------------
+    def _observe(self, step: int) -> Optional[ResizeRequest]:
+        from .testing import faults as _faults
+        w = runtime.world() if runtime.is_initialized() else None
+        world_size = w.size if w is not None else 1
+        target = _faults.resize_hook(step, world_size)
+        if target is None:
+            # The fault clause fires exactly once; a drill whose RPC
+            # failed transiently retries from here.
+            target, self._drill_retry = self._drill_retry, None
+        if target is not None and w is not None and w.env_world \
+                and w.coord is not None:
+            # Env-world drill: route through the REAL admin ingress so the
+            # whole plane (RPC -> notice -> ack piggyback) is exercised;
+            # rank 0 self-requests, everyone learns via the notice.
+            if w.process_index == 0:
+                from .coord.client import request_resize
+                from .utils import config as _config
+                try:
+                    request_resize(_config.coordinator_address(), target)
+                except Exception as e:  # noqa: BLE001 — drill ingress
+                    if "refused resize" in str(e):
+                        # Definitive rejection (bad target, conflicting
+                        # pending): retrying cannot change the answer.
+                        _log(f"resize drill rejected by the coordinator "
+                             f"({e}); dropping the drill")
+                    else:
+                        self._drill_retry = target
+                        _log(f"resize drill RPC failed ({e}); retrying "
+                             f"at the next step boundary")
+            target = None  # wait for the coordinator's notice like everyone
+        if target is None and self._local_request is not None:
+            target = self._local_request
+            self._local_request = None
+        if target is not None:
+            return ResizeRequest(target_world=int(target),
+                                 generation=resize_generation() + 1)
+        if w is not None and w.coord is not None:
+            pr = w.coord.pending_resize()
+            if pr is not None:
+                return ResizeRequest(target_world=pr.target_world,
+                                     coord_port=pr.coord_port or None,
+                                     generation=pr.generation)
+        return None
+
+    def poll(self, step: int) -> Optional[ResizeRequest]:
+        """Cheap step-boundary check. Returns the pending request once one
+        is known (its ``quiesce_step`` fills in after the world-wide
+        agreement completes); None on the hot path."""
+        if self._pending is None:
+            req = self._observe(step)
+            if req is None:
+                return None
+            self._pending = req
+            w = runtime.world() if runtime.is_initialized() else None
+            multi = w is not None and w.coord is not None \
+                and w.process_count > 1
+            _log(f"resize pending: world "
+                 f"{w.size if w else 1} -> {req.target_world} "
+                 f"(generation {req.generation}); quiescing at a step "
+                 f"boundary")
+            if multi:
+                # Ranks can observe the notice a step apart; agree on the
+                # world-wide quiesce step with one tiny MAX allreduce.
+                # Async submit: a rank must NOT block here while a peer
+                # may still be inside this step's training collectives —
+                # it redeems at its NEXT boundary, by when every peer has
+                # observed the notice and submitted its own proposal.
+                import numpy as np
+                from .ops.collectives import Op
+                self._proposal = w.coord.submit(
+                    "allreduce", np.asarray([step + 1], np.int64),
+                    f"resize{req.generation}_quiesce", op=Op.MAX)
+                self._proposed_at = step
+            else:
+                self._pending = dataclasses.replace(
+                    self._pending, quiesce_step=step)
+        if (self._pending.quiesce_step is None
+                and self._proposal is not None
+                and step > self._proposed_at):
+            import numpy as np
+            w = runtime.world()
+            agreed = int(np.asarray(w.coord.wait(self._proposal))[0])
+            self._proposal = None
+            self._pending = dataclasses.replace(
+                self._pending, quiesce_step=max(agreed, step))
+            _log(f"resize: world agreed to quiesce at step "
+                 f"{self._pending.quiesce_step}")
+        return self._pending
+
+    def due(self, step: int) -> bool:
+        return (self._pending is not None
+                and self._pending.quiesce_step is not None
+                and step >= self._pending.quiesce_step)
+
+    def step_boundary(self, step: int, *, params=None,
+                      opt_state=None) -> Optional[Rebuilt]:
+        """The trainer-loop quiesce hook: call once per completed step with
+        the current step count (and, when the loop owns the live trees —
+        ``Trainer.fit`` does — the current params/opt_state to sync into
+        the elastic state). Returns the :class:`Rebuilt` templates when a
+        resize just executed, else None."""
+        req = self.poll(step)
+        if req is None or not self.due(step):
+            return None
+        if params is not None:
+            self.state.params = params
+        if opt_state is not None:
+            self.state.opt_state = opt_state
+        self.state.step = int(step)
+        return self.execute(self._pending)
+
+    # -- the quiesce protocol ----------------------------------------------
+    def execute(self, req: ResizeRequest) -> Rebuilt:
+        """Quiesce → recommit → canonicalize → re-form → re-shard.
+
+        Called at the agreed step boundary on every rank of the OLD world.
+        Retiring env-world ranks (rank >= target) contribute their ZeRO
+        shards to the canonical form, then exit cleanly (SystemExit(0) —
+        the supervising tpurun reaps them as benign). Surviving ranks
+        re-form the coordination plane on the new port / re-init the local
+        mesh and re-shard in place. Any failure after the recommit falls
+        back to the full VERIFIED restore walk — the recommit is the
+        correctness anchor."""
+        import jax
+        state = self.state
+        w = runtime.world()
+        old_world, env = w.size, w.env_world
+        if w.process_count > 1 and not env:
+            raise ValueError(_RESIZE_UNSUPPORTED_WORLD)
+        target, gen = req.target_world, req.generation
+        my_rank = w.process_index
+        new_devs = None
+        if not env:
+            # Validate the new device set BEFORE tearing the old world
+            # down: an oversized grow target (typo'd request) must reject
+            # here, not kill a running job after shutdown.
+            new_devs = list(self.devices_fn(target) if self.devices_fn
+                            else jax.devices()[:target])
+            if len(new_devs) < target:
+                self._pending = None  # raise once, not at every boundary
+                self._proposal = None
+                raise ValueError(
+                    f"cannot grow to world {target}: only {len(new_devs)} "
+                    f"devices available (single-controller resize is "
+                    f"bounded by the visible device count)")
+        _log(f"resize: quiesced at step {state.step}; recommitting and "
+             f"canonicalizing before re-forming the world "
+             f"({old_world} -> {target}, generation {gen})")
+        # Recommit at the quiesce step through the unchanged two-phase
+        # commit (drains any async writer first): the verified-restore
+        # anchor if anything below fails, and the resume point if a rank
+        # dies mid-resize and the supervisor falls back to a full restart.
+        state.wait()
+        state.commit()
+        state.wait()
+        # Host-side canonical copies (ZeRO shards allgathered over the old
+        # plane in env-worlds — retiring ranks included).
+        host_params = _host_params(state.params)
+        host_opt = _canonicalize_opt(state.opt_state, env_world=env,
+                                     generation=gen)
+        host_step = int(state.step)
+        coord_host = ""
+        if env:
+            from .utils import config as _config
+            addr = _config.coordinator_address() or "127.0.0.1"
+            coord_host = addr.partition(":")[0] or "127.0.0.1"
+        # Old world down. From here until re-init there is no plane; the
+        # recommit above is the safety net.
+        runtime.shutdown()
+        if env and my_rank >= target:
+            _log(f"resize: rank {my_rank} retiring at step {host_step} "
+                 f"(world {old_world} -> {target}, generation {gen})")
+            sys.stdout.flush()
+            raise SystemExit(0)
+        try:
+            if env:
+                if not req.coord_port:
+                    raise ValueError(
+                        "env-world resize request carries no coordinator "
+                        "port for the new world (notice missing?)")
+                os.environ["HVD_SIZE"] = str(target)
+                os.environ["HVD_COORD_ADDR"] = \
+                    f"{coord_host}:{req.coord_port}"
+                os.environ["HVD_RESIZE_GENERATION"] = str(gen)
+                runtime.init()
+            else:
+                runtime.init(devices=new_devs)
+            rebuilt = self._rebuild_templates(target, host_params,
+                                              host_opt)
+            if env and target > old_world:
+                # Grow: ship (step, params, canonical opt) to the joined
+                # ranks over the new plane — no disk involved. Shrink
+                # needs no sync: every survivor already holds the full
+                # canonical state.
+                host_step, host_params, host_opt = _sync_state_over_plane(
+                    host_step, host_params, host_opt, gen)
+            state.params = _place_params(host_params, rebuilt.params)
+            state.opt_state = _reshard_opt(host_opt, rebuilt.opt_state)
+            state.step = host_step
+            self._pending = None
+            self._proposal = None
+            self.resizes_completed += 1
+            _log(f"resize complete: re-sharded optimizer state in place "
+                 f"onto world {target} (generation {gen}); resuming at "
+                 f"step {state.step} without restart")
+            return rebuilt
+        except RECOVERABLE:
+            # The plane died under the resize (e.g. a racing kill): local
+            # recovery is impossible — surface to run_with_recovery so the
+            # supervisor restarts the world and the VERIFIED restore walk
+            # resumes from the recommit.
+            raise
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — fallback is the contract
+            _log(f"resize: in-place re-shard failed ({e!r}); falling back "
+                 f"to full verified restore of the quiesce commit")
+            if not runtime.is_initialized():
+                raise
+            if runtime.world().process_count > 1:
+                # Multi-process world: the local restore's cross-rank
+                # agreement would be a collective the OTHER ranks (which
+                # may have resized successfully and returned to training)
+                # never join — an asymmetric failure would hang the world
+                # instead of recovering it. Exit to the supervisor: the
+                # whole world relaunches and resumes from the quiesce
+                # recommit via the verified walk.
+                _log("resize: fallback on a multi-process world exits for "
+                     "a supervised restart (a rank-local restore would "
+                     "desynchronize the plane)")
+                raise
+            rebuilt = self._rebuild_templates(target, host_params,
+                                              host_opt)
+            state.params = rebuilt.params
+            state.opt_state = rebuilt.opt_state
+            state.restore()   # verified walk; raises if even that fails
+            self._pending = None
+            self._proposal = None
+            self.resizes_completed += 1
+            _log(f"resize complete (via verified restore fallback): "
+                 f"world {target}, resuming at step {state.step}")
+            return rebuilt
+
+    def _rebuild_templates(self, target: int, host_params,
+                           host_opt) -> Rebuilt:
+        if self.rebuild is not None:
+            return _normalize_rebuilt(self.rebuild(target))
+        if host_opt is not None and any(
+                _is_zero_node(x) for x in _tree_nodes(host_opt)):
+            raise ValueError(
+                "resizing a ZeRO-sharded optimizer state requires "
+                "ResizeCoordinator(rebuild=...): the sharded layout is "
+                "world-shaped, so the new world's templates must be "
+                "rebuilt (re-run the optimizer init / create_train_state "
+                "at the new size)")
+        return Rebuilt(params=host_params, opt_state=host_opt)
+
+
+def _tree_nodes(tree):
+    import jax
+    return jax.tree_util.tree_leaves(
+        tree, is_leaf=_is_zero_node)
+
+
+def resize_join(state: ElasticState) -> ElasticState:
+    """Join an in-flight world as a grow-spawned rank (tpurun sets
+    ``HVD_RESIZE_GENERATION`` on ranks it adds mid-run). The joiner's own
+    freshly-initialized trees are already world-correct templates; the
+    live (step, params, canonical opt) arrives over the coordination plane
+    from rank 0 — no rank reads disk. Called automatically by
+    :func:`run_with_recovery`."""
+    gen = resize_generation()
+    w = runtime.world()
+    _log(f"resize: rank {w.process_index} joining world {w.size} at "
+         f"generation {gen}; receiving live state over the plane")
+    host_opt = _canonicalize_opt(state.opt_state, env_world=False,
+                                 generation=gen, placeholders=True)
+    step, host_params, host_opt = _sync_state_over_plane(
+        0, _host_params(state.params), host_opt, gen)
+    state.params = _place_params(host_params, state.params)
+    state.opt_state = _reshard_opt(host_opt, state.opt_state)
+    state.step = step
+    # Commit immediately: until this rank has its own committed state, a
+    # full-world crash-restart would find its directory empty and drag the
+    # cross-rank restore agreement back to step 0.
+    state.commit()
+    state.wait()
+    _log(f"resize: joined at step {step} and committed")
+    return state
+
+
 def run_with_recovery(train_fn: Callable[[ElasticState], Any],
                       state: ElasticState):
     """Run ``train_fn(state)`` with checkpoint-recovery semantics.
@@ -339,7 +1009,18 @@ def run_with_recovery(train_fn: Callable[[ElasticState], Any],
 
     Returns whatever ``train_fn`` returns on success.
     """
-    committed = state.latest_committed()  # one cross-rank agreement round
+    joining = (resize_generation() > 0 and runtime.is_initialized()
+               and runtime.world().env_world
+               and state._local_latest(verify=False) is None)
+    if joining:
+        # A grow-spawned rank joining an in-flight resize (tpurun set
+        # HVD_RESIZE_GENERATION and this rank has never committed): the
+        # live state arrives over the plane, not from disk — the
+        # surviving ranks are mid-resize waiting in the same broadcast.
+        resize_join(state)
+        committed = None
+    else:
+        committed = state.latest_committed()  # one cross-rank agreement
     if committed is not None:
         # _restore_step skips the second verify pass only when THIS
         # rank's walk proved the agreed step; the cross-rank min can be
@@ -350,9 +1031,18 @@ def run_with_recovery(train_fn: Callable[[ElasticState], Any],
             print(f"[elastic] discarded {state.discarded_corrupt} "
                   f"committed-but-corrupt checkpoint(s); resuming from "
                   f"verified step {state.step}", flush=True)
+        # Operators must be able to tell a clean resume from a fallback
+        # walk WITHOUT DEBUG: the restore-walk outcome is logged on every
+        # recovery, not only when verification re-ran.
+        _log(f"recovery: resumed from committed step {state.step} "
+             f"(restore walk: discarded_corrupt={state.discarded_corrupt}"
+             f", {'fallback walk engaged' if state.discarded_corrupt else 'clean latest commit'})")
         if restart_epoch() > 0:
             print(f"[elastic] restart epoch {restart_epoch()}: resumed "
                   f"from committed step {state.step}", flush=True)
+    elif not joining:
+        _log("recovery: no committed state found — starting from "
+             "scratch (restore walk: nothing to restore)")
     try:
         return train_fn(state)
     except RECOVERABLE as e:

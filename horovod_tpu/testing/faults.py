@@ -14,10 +14,14 @@ This module turns an env spec into precise failures:
     HVD_FAULT_SPEC=ckpt:truncate@step=5        # tear the step-5 checkpoint
     HVD_FAULT_SPEC=ckpt:flip@step=5            # flip one byte in it
     HVD_FAULT_SPEC=ckpt:drop_marker@step=5     # lose its commit marker
+    HVD_FAULT_SPEC=resize:shrink=2@step=3      # live-shrink the world by 2
+    HVD_FAULT_SPEC=resize:grow=4@step=3        # live-grow the world by 4
+    HVD_FAULT_SPEC=resize:world=2@step=3       # live-resize to exactly 2
 
 Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>``,
-``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``, or
-``ckpt:<truncate|flip|drop_marker>@step=<s>``. Step-scoped actions
+``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``,
+``ckpt:<truncate|flip|drop_marker>@step=<s>``, or
+``resize:<shrink|grow|world>=<k>@step=<s>``. Step-scoped actions
 REQUIRE ``@step`` (a clause that could never fire is rejected loudly);
 ``delay_ms`` is unconditional — it has no step context and rejects
 ``@step``. Every clause takes an optional ``@epoch=<e>`` suffix
@@ -30,6 +34,20 @@ step, strictly AFTER the two-phase commit completes (marker on disk) —
 modeling post-commit bit rot / torn replication, the failure class the
 integrity manifests + verified fallback restore exist for. They fire on
 every rank (each env-world rank owns a private checkpoint copy).
+
+``resize`` clauses inject a live elastic resize at the matching step
+boundary — the chaos-drill analog of a spot-preemption notice
+(``kill -USR1`` on tpurun) or an operator's admin RPC. ``shrink=K`` /
+``grow=K`` are relative (world − K / world + K, the
+"K chips preempted / K chips granted" shapes); ``world=N`` is absolute.
+:func:`resize_hook` is polled by
+:class:`horovod_tpu.elastic.ResizeCoordinator` at step boundaries; in a
+tpurun env-world only rank 0 acts on it (it sends the admin RPC to its
+own coordinator, so the drill exercises the REAL ingress path end to
+end), in a single-controller world the hook's target is applied
+directly. Compose with ``rank=<r>:kill@step=<s>`` to race a resize
+against a worker death (the quiesce must fall back to the verified
+restore walk).
 
 Actions:
 
@@ -66,8 +84,10 @@ from typing import List, Optional
 ENV_VAR = "HVD_FAULT_SPEC"
 
 _ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms",
-            "truncate", "flip", "drop_marker")
+            "truncate", "flip", "drop_marker",
+            "shrink", "grow", "world")
 _CKPT_ACTIONS = ("truncate", "flip", "drop_marker")
+_RESIZE_ACTIONS = ("shrink", "grow", "world")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,10 +116,10 @@ def parse_spec(text: str) -> List[Fault]:
                 raise FaultSpecError(
                     f"{ENV_VAR}: bad rank in clause {clause!r}") from None
             target = "rank"
-        elif target not in ("coord", "ckpt"):
+        elif target not in ("coord", "ckpt", "resize"):
             raise FaultSpecError(
                 f"{ENV_VAR}: clause {clause!r} must start with "
-                f"'rank=<r>:', 'coord:' or 'ckpt:'")
+                f"'rank=<r>:', 'coord:', 'ckpt:' or 'resize:'")
         if not rest:
             raise FaultSpecError(f"{ENV_VAR}: clause {clause!r} has no action")
         parts = rest.split("@")
@@ -111,6 +131,24 @@ def parse_spec(text: str) -> List[Fault]:
                 raise FaultSpecError(
                     f"{ENV_VAR}: bad delay in clause {clause!r}") from None
             action = "delay_ms"
+        elif any(action.startswith(a + "=") for a in _RESIZE_ACTIONS):
+            key, _, val = action.partition("=")
+            try:
+                value = int(val)
+            except ValueError:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: bad {key} value in clause {clause!r} "
+                    f"(expected {key}=<positive int>)") from None
+            if value < 1:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: {key}={value} in clause {clause!r} — a "
+                    f"resize delta/target must be >= 1 (a world cannot "
+                    f"shrink by zero or resize to zero ranks)")
+            action = key
+        elif action in _RESIZE_ACTIONS:
+            raise FaultSpecError(
+                f"{ENV_VAR}: clause {clause!r} — {action} needs a value "
+                f"({action}=<k>); a resize with no size tests nothing")
         if action not in _ACTIONS:
             raise FaultSpecError(
                 f"{ENV_VAR}: unknown action {action!r} in clause "
@@ -140,6 +178,15 @@ def parse_spec(text: str) -> List[Fault]:
             raise FaultSpecError(
                 f"{ENV_VAR}: clause {clause!r} — actions {_CKPT_ACTIONS} "
                 f"require (and are the only actions of) the 'ckpt:' "
+                f"target")
+        if (action in _RESIZE_ACTIONS) != (target == "resize"):
+            # Same discipline for the resize plane: shrink/grow/world fire
+            # from the step-boundary resize hook, not the rank/coord/ckpt
+            # hooks, and the resize target supports nothing else (killing
+            # a rank is a failure, not a resize).
+            raise FaultSpecError(
+                f"{ENV_VAR}: clause {clause!r} — actions {_RESIZE_ACTIONS} "
+                f"require (and are the only actions of) the 'resize:' "
                 f"target")
         if action == "delay_ms" and step is not None:
             # The delay applies to EVERY submit (there is no step context
@@ -226,8 +273,8 @@ def step_hook(step: int) -> None:
         return
     epoch = _restart_epoch()
     for i, f in enumerate(faults):
-        if f.target == "ckpt":
-            continue  # fires from ckpt_hook on the commit path instead
+        if f.target in ("ckpt", "resize"):
+            continue  # fire from ckpt_hook / resize_hook instead
         if f.action == "delay_ms" or f.step != step or f.epoch != epoch:
             continue
         if f.target == "rank" and f.rank != _my_rank():
@@ -322,6 +369,51 @@ def ckpt_hook(step: int, ckpt_dir: str, marker: str) -> None:
             continue
         _fired.add(key)
         _corrupt_checkpoint(f, ckpt_dir, marker)
+
+
+def resize_hook(step: int, world_size: int) -> Optional[int]:
+    """Target world size of any ``resize:*`` clause firing at ``step``,
+    or None. Called once per step boundary by
+    :class:`horovod_tpu.elastic.ResizeCoordinator` (near-zero-cost no-op
+    unless the spec has a resize clause).
+
+    ``shrink=K``/``grow=K`` are relative to ``world_size`` (the
+    spot-preemption shape: K chips lost/granted); ``world=N`` is
+    absolute. A clause that resolves to a target < 1 raises loudly — a
+    drill that asks for an impossible world must not be silently
+    clamped. A target equal to the current world is logged and skipped
+    (already that size — nothing to drill)."""
+    faults = _active()
+    if not faults:
+        return None
+    epoch = _restart_epoch()
+    for i, f in enumerate(faults):
+        if f.target != "resize" or f.step != step or f.epoch != epoch:
+            continue
+        key = (i, epoch)
+        if key in _fired:
+            continue
+        _fired.add(key)
+        if f.action == "shrink":
+            target = world_size - f.value
+        elif f.action == "grow":
+            target = world_size + f.value
+        else:
+            target = f.value
+        if target < 1:
+            raise FaultSpecError(
+                f"{ENV_VAR}: resize clause {f.action}={f.value} at step "
+                f"{step} resolves to target world {target} from world "
+                f"{world_size} — a world needs at least 1 rank")
+        if target == world_size:
+            print(f"[faults] resize drill at step {step}: world is "
+                  f"already {world_size} — nothing to do", flush=True)
+            return None
+        print(f"[faults] rank {_my_rank()}: injecting live resize "
+              f"{world_size} -> {target} at epoch {epoch} step {step}",
+              flush=True)
+        return target
+    return None
 
 
 def coord_delay() -> None:

@@ -39,7 +39,8 @@ class Trainer:
                  verbose: Optional[bool] = None,
                  prefetch: int = 2,
                  max_bad_steps: Optional[int] = None,
-                 elastic: Any = None):
+                 elastic: Any = None,
+                 resize: Any = None):
         self.train_step = train_step
         self.eval_step = eval_step
         self.state = state
@@ -74,6 +75,12 @@ class Trainer:
                               if max_bad_steps is None
                               else max(1, int(max_bad_steps)))
         self.elastic = elastic
+        # Live-resize quiesce hook (horovod_tpu.elastic.ResizeCoordinator):
+        # polled once per completed step — one atomic load on the hot path.
+        # When a resize executes, the current epoch ends early (its input
+        # stream was sharded for the OLD world) and the next epoch runs on
+        # the re-formed world with the rebuilt train step.
+        self.resize = resize
         self._bad_counter = None
         self._bad_add = None
 
@@ -185,6 +192,51 @@ class Trainer:
                   f"consecutive skips) — rolled back to verified "
                   f"elastic step {es.step}", file=sys.stderr, flush=True)
 
+    def _maybe_resize(self) -> bool:
+        """The step-boundary quiesce hook of the live-resize plane: sync
+        the live trees into the elastic state, let the
+        :class:`~horovod_tpu.elastic.ResizeCoordinator` poll (one atomic
+        load when nothing is pending) and — once the world-wide quiesce
+        step is reached — execute the in-place resize. Returns True when
+        the world was just re-formed (the caller must abandon the current
+        epoch's input stream)."""
+        import numpy as np
+        step = int(self.state.step)
+        rc = self.resize
+        req = rc.poll(step)
+        if req is None or not rc.due(step):
+            return False
+        # batch_stats are not part of the committed elastic state; carry
+        # them across the re-form host-side (re-placed replicated — the
+        # rebuild's train step re-shards them on first use if it must).
+        host_bs = None
+        if self.state.batch_stats is not None:
+            host_bs = jax.tree_util.tree_map(np.asarray,
+                                             self.state.batch_stats)
+        rebuilt = rc.step_boundary(step, params=self.state.params,
+                                   opt_state=self.state.opt_state)
+        if rebuilt is None:
+            return False
+        new_bs = None
+        if host_bs is not None:
+            new_bs = jax.tree_util.tree_map(jnp.asarray, host_bs)
+        self.state = dataclasses.replace(
+            self.state, params=rc.state.params,
+            opt_state=rc.state.opt_state, batch_stats=new_bs,
+            step=jnp.asarray(rc.state.step, self.state.step.dtype))
+        if rebuilt.train_step is not None:
+            self.train_step = rebuilt.train_step
+        # Mesh-tied host-side caches die with the old world.
+        self._eval_placer = None
+        self._metric_add = None
+        self._bad_add = None
+        self._bad_counter = None
+        if self.verbose:
+            print(f"[trainer] live resize executed at step "
+                  f"{int(self.state.step)}; epoch ends early, training "
+                  f"resumes on the new world", file=sys.stderr, flush=True)
+        return True
+
     def fit(self, data: Callable[[], Iterable], epochs: int = 1,
             callbacks: Optional[List] = None,
             eval_data: Optional[Callable[[], Iterable]] = None,
@@ -214,6 +266,7 @@ class Trainer:
             nsteps = 0
             bad_steps = 0
             guard_active = False
+            resized_early = False
             metric_sums = None
             stream = self._stream(data())
             try:
@@ -240,11 +293,20 @@ class Trainer:
                     nsteps += 1
                     _faults.step_hook(self._global_step)
                     self._global_step += 1
+                    if self.resize is not None and self._maybe_resize():
+                        # World re-formed in place: the rest of this
+                        # epoch's stream is sharded for the old world —
+                        # end the epoch here, resume on the new world.
+                        resized_early = True
+                        break
             finally:
                 close = getattr(stream, "close", None)
                 if close is not None:
                     close()
-            if self.steps_per_epoch is None:
+            if self.steps_per_epoch is None and not resized_early:
+                # A resize-truncated epoch must not be recorded as the
+                # inferred epoch length — it would silently cap every
+                # later epoch at the truncation point.
                 self.steps_per_epoch = nsteps
 
             # Epoch logs are the running mean over the epoch's batches (the

@@ -31,6 +31,31 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 budgeted run "
                    "(multi-minute compiles / hardware-evidence tests)")
+    config.addinivalue_line(
+        "markers", "subprocess_env(reason=...): tpurun-subprocess tests "
+                   "that cannot pass in THIS environment for a named "
+                   "infrastructure reason (not a product bug) — skipped "
+                   "unless HVD_SUBPROCESS_ENV_TESTS=1, so tier-1 reads "
+                   "green-or-real instead of known-dead dots")
+
+
+def pytest_collection_modifyitems(config, items):
+    # subprocess_env: skip with the site's named environment reason so the
+    # tier-1 report distinguishes "this environment can't run it" from a
+    # real failure. Set HVD_SUBPROCESS_ENV_TESTS=1 (e.g. on a TPU VM or an
+    # image whose jaxlib supports what the test needs) to run them anyway.
+    if os.environ.get("HVD_SUBPROCESS_ENV_TESTS") == "1":
+        return
+    for item in items:
+        m = item.get_closest_marker("subprocess_env")
+        if m is None:
+            continue
+        reason = m.kwargs.get("reason") or (m.args[0] if m.args else
+                                            "environment cannot run "
+                                            "tpurun-subprocess worlds")
+        item.add_marker(pytest.mark.skip(
+            reason=f"subprocess_env: {reason} "
+                   f"(HVD_SUBPROCESS_ENV_TESTS=1 overrides)"))
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -1,0 +1,105 @@
+"""Live-resize driver for the elastic chaos drills.
+
+Like ``elastic_worker.py`` but built for in-place world resizes: each
+step's "gradient" is the SUM over a fixed virtual global batch of
+``GLOBAL_ROWS`` rows, with every rank contributing its contiguous slice
+— so the reduced gradient is a pure function of the step, independent of
+how many ranks split the rows. All row values are small dyadic rationals
+(integer multiples of 1/64) and every coefficient is a power of two, so
+the float sums are EXACT regardless of grouping: a run that live-resizes
+mid-training MUST finish with bit-identical params to an uninterrupted
+run at the final world size — the acceptance check for
+quiesce→recommit→re-shard (ISSUE 9).
+
+Env:
+  HVD_ELASTIC_DIR     checkpoint directory (required)
+  HVD_TOTAL_STEPS     steps to train (default 6)
+  HVD_FAULT_SPEC      fault injection incl. resize:* drills (faults.py)
+
+Prints ``rank <r>/<s>: FINAL <checksum> step <n>`` on success. The
+checksum depends only on (TOTAL_STEPS, final world's training math) —
+compare against an uninterrupted run at the final world size.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+from horovod_tpu.testing import faults  # noqa: E402
+
+TOTAL_STEPS = int(os.environ.get("HVD_TOTAL_STEPS", "6"))
+# Per-step host sleep (ms): slows the loop down so signal-driven drills
+# (kill -USR1/-USR2 on the launcher) land on a still-running job.
+STEP_SLEEP_MS = int(os.environ.get("HVD_STEP_SLEEP_MS", "0"))
+GLOBAL_ROWS = 8   # world size must divide this (1, 2, 4 or 8 ranks)
+DIM = 8
+
+
+def rank_grad(step: int, rank: int, size: int) -> jnp.ndarray:
+    """This rank's partial sum over its slice of the virtual global batch.
+
+    Row values are integer multiples of 1/64 bounded well inside the
+    fp32 mantissa, so the cross-rank SUM is exact under any grouping —
+    the reduced gradient is bit-identical at any world size.
+    """
+    rows = GLOBAL_ROWS // size
+    base = np.arange(DIM, dtype=np.float32) + 1.0
+    out = np.zeros(DIM, np.float32)
+    for row in range(rank * rows, (rank + 1) * rows):
+        v = ((step * 31 + row * 7) % 16 - 8) / 8.0   # dyadic in [-1, 1)
+        out += v * base / 8.0
+    return jnp.asarray(out)
+
+
+def train(state: elastic.ElasticState):
+    rc = elastic.ResizeCoordinator(state)
+    while state.step < TOTAL_STEPS:
+        if STEP_SLEEP_MS:
+            import time
+            time.sleep(STEP_SLEEP_MS / 1000.0)
+        step = state.step
+        # A racing kill drill fires HERE — before the step's collective.
+        faults.step_hook(step)
+        r, s = hvd.rank(), hvd.size()   # re-read: a resize changes them
+        if GLOBAL_ROWS % s:
+            raise SystemExit(f"world {s} does not divide {GLOBAL_ROWS}")
+        g = hvd.allreduce(rank_grad(step, r, s), average=False,
+                          name=f"resize_grad_{step}")
+        state.params = {
+            "w": state.params["w"] - 0.125 * g,
+            "m": state.params["m"] * 0.5 + 0.25 * g,
+        }
+        state.advance()
+        # Step-boundary quiesce hook: one atomic load unless a resize is
+        # pending; executes the in-place re-form at the agreed step.
+        rc.step_boundary(state.step)
+    return state
+
+
+def main():
+    hvd.init()
+    params = {"w": jnp.zeros((DIM,), jnp.float32),
+              "m": jnp.zeros((DIM,), jnp.float32)}
+    state = elastic.ElasticState(params, opt_state=None, step=0,
+                                 commit_every=1)
+    state = elastic.run_with_recovery(train, state)
+    r, s = hvd.rank(), hvd.size()
+    checksum = float(jnp.sum(jnp.abs(state.params["w"]))
+                     + jnp.sum(jnp.abs(state.params["m"])))
+    print(f"rank {r}/{s}: FINAL {checksum:.10f} step {state.step}",
+          flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
