@@ -13,9 +13,17 @@ expiries).
     JAX_PLATFORMS=cpu python bin/serve_bench.py --qps 200 --duration 5
     python bin/serve_bench.py --qps 50,100,200,400 --duration 10  # curve
 
+``--mode generate`` drives the continuous-batching generation engine
+instead (a small transformer LM, mixed prompt lengths): per operating
+point it reports p50/p99 **time-to-first-token**, per-user and aggregate
+tokens/sec, and decode-slot occupancy.
+
+    JAX_PLATFORMS=cpu python bin/serve_bench.py --mode generate \
+        --qps 20 --duration 5
+
 Exit status is nonzero if any *in-deadline* request was dropped at the
-configured operating point — the regression gate ci.sh's serve smoke
-relies on.
+configured operating point — the regression gate ci.sh's serve smokes
+rely on (the generate smoke additionally requires nonzero tokens/sec).
 """
 
 from __future__ import annotations
@@ -70,6 +78,83 @@ def _build_engine(args):
     print(f"warmup: {len(serve.bucket_sizes(args.max_batch))} buckets "
           f"pre-compiled in {time.monotonic() - t0:.2f} s")
     return eng
+
+
+def _build_gen_engine(args):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.parallel.transformer import (TransformerConfig,
+                                                  init_params)
+    from horovod_tpu import serve
+
+    # Small but real: the bench measures the serving plane (slot churn,
+    # prefill/decode interleave, streaming), not model quality.
+    cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=128, dtype=jnp.float32,
+                            unembed_dtype=jnp.float32, attn_backend="xla")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    gcfg = serve.GenerationConfig(
+        max_slots=args.slots, max_len=args.max_len,
+        max_queue=args.max_queue, default_deadline_ms=args.deadline_ms,
+        default_max_new_tokens=args.gen_tokens)
+    eng = serve.GenerationEngine(params, cfg, gcfg)
+    t0 = time.monotonic()
+    warmed = eng.warmup()
+    print(f"warmup: decode + {len(warmed) - 1} prefill buckets "
+          f"pre-compiled in {time.monotonic() - t0:.2f} s")
+    return eng
+
+
+def run_gen_point(eng, qps: float, duration: float,
+                  rng: np.random.RandomState, args) -> dict:
+    """One generation operating point: open-loop prompt arrivals; TTFT
+    and per-user tokens/sec come from the engine-stamped result dicts
+    (submit → first token / first → last token)."""
+    from horovod_tpu.exceptions import (DeadlineExceededError,
+                                        ServerOverloadedError)
+    n = max(1, int(qps * duration))
+    period = 1.0 / qps
+    handles = []
+    overload = 0
+    start = time.monotonic()
+    for i in range(n):
+        delay = start + i * period - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        prompt = rng.randint(1, 255, size=rng.randint(4, 17)).tolist()
+        try:
+            handles.append(eng.submit(prompt))
+        except ServerOverloadedError:
+            overload += 1
+    ttft_ms, tps_user, tokens_out = [], [], 0
+    expired, failed = 0, 0
+    for h in handles:
+        try:
+            r = h.result(timeout=120)
+            ttft_ms.append(r["ttft_ms"])
+            tokens_out += r["n_tokens"]
+            if r["tokens_per_sec"] is not None:
+                tps_user.append(r["tokens_per_sec"])
+        except DeadlineExceededError:
+            expired += 1
+        except Exception:
+            failed += 1
+    wall = time.monotonic() - start
+    snap = eng.stats()
+    return {
+        "qps_target": qps,
+        "sent": n,
+        "completed": len(ttft_ms),
+        "ttft_p50_ms": _percentile(ttft_ms, 0.50),
+        "ttft_p99_ms": _percentile(ttft_ms, 0.99),
+        "tokens_per_sec": tokens_out / wall,
+        "tps_user_p50": _percentile(tps_user, 0.50),
+        "overload_drops": overload,
+        "deadline_drops": expired,
+        "failed": failed,
+        "slot_fill": snap["batch_fill_ratio"],
+    }
 
 
 def run_point(eng, qps: float, duration: float, rng: np.random.RandomState,
@@ -138,6 +223,10 @@ def run_point(eng, qps: float, duration: float, rng: np.random.RandomState,
 
 def main():
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--mode", choices=("predict", "generate"),
+                   default="predict",
+                   help="predict: single-shot Engine; generate: the "
+                        "continuous-batching GenerationEngine")
     p.add_argument("--qps", default="200",
                    help="target request rate; comma-separate for a curve")
     p.add_argument("--duration", type=float, default=5.0,
@@ -148,9 +237,19 @@ def main():
     p.add_argument("--max-queue", type=int, default=512)
     p.add_argument("--deadline-ms", type=float, default=1000.0,
                    help="per-request deadline (0 disables)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="[generate] concurrent decode slots")
+    p.add_argument("--max-len", type=int, default=128,
+                   help="[generate] KV-cache depth (prompt + generated)")
+    p.add_argument("--gen-tokens", type=int, default=16,
+                   help="[generate] tokens generated per request")
     args = p.parse_args()
     if args.deadline_ms == 0:
         args.deadline_ms = None
+
+    if args.mode == "generate":
+        run_generate(args)
+        return
 
     eng = _build_engine(args)
     rng = np.random.RandomState(0)
@@ -178,6 +277,40 @@ def main():
     eng.shutdown()
     if dropped_in_deadline:
         print(f"FAIL: {dropped_in_deadline} in-deadline requests dropped")
+        sys.exit(1)
+    print("SERVE BENCH OK")
+
+
+def run_generate(args):
+    eng = _build_gen_engine(args)
+    rng = np.random.RandomState(0)
+    points = [float(q) for q in str(args.qps).split(",")]
+    hdr = (f"{'qps→':>8}{'done':>7}{'ttft p50':>10}{'ttft p99':>10}"
+           f"{'tok/s':>9}{'tok/s/u':>9}{'fill':>7}{'overload':>10}"
+           f"{'deadline':>10}")
+    print(hdr)
+    dropped_in_deadline = 0
+    total_tps = 0.0
+    for q in points:
+        row = run_gen_point(eng, q, args.duration, rng, args)
+        dropped_in_deadline += row["overload_drops"] + row["failed"]
+        total_tps += row["tokens_per_sec"]
+        print(f"{row['qps_target']:>8.0f}{row['completed']:>7}"
+              f"{row['ttft_p50_ms']:>10.2f}{row['ttft_p99_ms']:>10.2f}"
+              f"{row['tokens_per_sec']:>9.1f}{row['tps_user_p50']:>9.1f}"
+              f"{(row['slot_fill'] or 0):>7.2f}"
+              f"{row['overload_drops']:>10}{row['deadline_drops']:>10}")
+        if not (np.isfinite(row["ttft_p50_ms"])
+                and np.isfinite(row["ttft_p99_ms"])):
+            print("FAIL: empty TTFT report (no request completed)")
+            eng.shutdown(drain=False)
+            sys.exit(1)
+    eng.shutdown()
+    if dropped_in_deadline:
+        print(f"FAIL: {dropped_in_deadline} in-deadline requests dropped")
+        sys.exit(1)
+    if not total_tps > 0:
+        print("FAIL: zero aggregate tokens/sec")
         sys.exit(1)
     print("SERVE BENCH OK")
 

@@ -45,6 +45,18 @@ echo "== serving smoke: warm the buckets, 200 QPS for 5 s, assert the drop gate 
 # report — serve_bench exits nonzero on any violation.
 run_cpu timeout -k 10 180 python bin/serve_bench.py --qps 200 --duration 5
 
+echo "== serving smoke: continuous-batching generation (TTFT + tokens/sec gate) =="
+# The generation plane's CI contract (docs/inference.md "Generation"):
+# prefill/decode buckets pre-compile, open-loop prompt arrivals sustain
+# the rate with slots joining/leaving mid-flight, ZERO in-deadline drops,
+# nonzero aggregate tokens/sec, and a non-empty p50/p99 TTFT report —
+# serve_bench exits nonzero on any violation.
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 5000
+# The slow-marked HTTP /generate drills (chunked streaming, healthz
+# lifecycle) run here, outside the tier-1 marker filter.
+timeout -k 10 300 python -m pytest tests/test_generate.py -q
+
 echo "== striped host reduce (multi-core validation, gated on nproc) =="
 if [ "$(nproc)" -gt 1 ]; then
   # On a >=4-core host, striping must not LOSE to the serial reduce at

@@ -24,8 +24,12 @@ from .tp import (  # noqa: F401
 )
 from .transformer import (  # noqa: F401
     TransformerConfig,
+    decode_step,
     forward,
+    init_kv_cache,
     init_params,
+    kv_cache_specs,
     make_parallel_train_step,
     param_specs,
+    prefill,
 )

@@ -27,6 +27,7 @@ import zlib
 from typing import Any, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .. import runtime
@@ -460,8 +461,34 @@ def restore_sharded(directory: str, params_template: Any,
     return restored["params"], restored["opt_state"], int(step)
 
 
+#: restore_for_inference's serving dtypes. None = as stored; "int8" is
+#: weight-only per-channel quantization (ops/quant.py) the generation
+#: forward dequantizes in-jit.
+INFERENCE_DTYPES = (None, "fp32", "bf16", "int8")
+
+
+def _inference_cast(variables: Any, dtype: Optional[str]) -> Any:
+    """Apply the serving dtype AFTER restore+CRC-verify: manifests record
+    the stored fp32 bytes, so verification must never see the quantized
+    or downcast view (the int8 round-trip contract)."""
+    if dtype is None:
+        return variables
+    if dtype == "int8":
+        from ..ops.quant import quantize_tree
+        return quantize_tree(variables)
+    target = {"fp32": np.float32, "bf16": jnp.bfloat16}[dtype]
+
+    def _one(x):
+        a = np.asarray(x)
+        return a.astype(target) if np.issubdtype(a.dtype, np.floating) \
+            else a
+
+    return jax.tree_util.tree_map(_one, variables)
+
+
 def restore_for_inference(directory: str, step: Optional[int] = None, *,
-                          mesh=None, spec_fn=None) -> Any:
+                          mesh=None, spec_fn=None,
+                          dtype: Optional[str] = None) -> Any:
     """Load a checkpoint's serving state — the restore entry point behind
     :mod:`horovod_tpu.serve`.
 
@@ -475,6 +502,17 @@ def restore_for_inference(directory: str, step: Optional[int] = None, *,
     because serving needs neither the optimizer state nor the step: the
     training-only subtrees are dropped unread rather than restored and
     discarded.
+
+    ``dtype`` picks the serving precision (:data:`INFERENCE_DTYPES`;
+    validated eagerly, before any checkpoint I/O): ``None`` serves the
+    stored dtypes, ``"fp32"``/``"bf16"`` cast every float leaf, and
+    ``"int8"`` quantizes matmul weights (float leaves of ndim >= 2) to
+    :class:`~horovod_tpu.ops.quant.QuantizedTensor` — int8 payload +
+    per-channel f32 scales that the generation forward dequantizes
+    in-jit (weights stay int8 in HBM). Quantization happens strictly
+    AFTER manifest verification: CRCs are checked against the stored
+    fp32 leaves, never the quantized view, so ``verify_checkpoint`` and
+    the int8 serving path see the same bytes.
 
     With ``mesh`` set, every leaf is placed as a global ``jax.Array``
     laid out by :func:`horovod_tpu.parallel.mesh.named_sharding_tree`
@@ -490,6 +528,10 @@ def restore_for_inference(directory: str, step: Optional[int] = None, *,
     CRC-verified against it (a subset check: the training-only subtrees
     stay unread, which is the point of the partial restore).
     """
+    if dtype not in INFERENCE_DTYPES:
+        raise ValueError(
+            f"restore_for_inference dtype={dtype!r} is not supported; "
+            f"supported: {INFERENCE_DTYPES} (None = as stored)")
     import orbax.checkpoint as ocp
     if step is None:
         step = latest_checkpoint_step(directory)
@@ -524,6 +566,7 @@ def restore_for_inference(directory: str, step: Optional[int] = None, *,
     manifest = read_manifest(path)
     if manifest is not None:
         _verify_leaves(path, manifest, variables, subset=True)
+    variables = _inference_cast(variables, dtype)
     if mesh is None:
         return variables
     from .mesh import named_sharding_tree

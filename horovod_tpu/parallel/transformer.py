@@ -302,6 +302,194 @@ def chunked_nll(x, embed, labels, cfg: TransformerConfig):
     return (lse - ll).reshape(orig_shape)
 
 
+# ---------------------------------------------------------------------------
+# Autoregressive generation: the prefill/decode pair over a slot-indexed KV
+# cache (the model layer under horovod_tpu.serve.generate's continuous-
+# batching engine). Pure functions of (params, cache) — the cache is a plain
+# pytree so it jits, donates, and shards like any other state. Unlike the
+# training forward these run OUTSIDE shard_map: params placed with
+# ``param_specs`` NamedShardings partition the matmuls under GSPMD, and
+# ``kv_cache_specs`` shards the cache's head axis over ``tp`` to match the
+# column-parallel wqkv layout (a tp column-slice holds whole heads).
+# Dense models only (n_experts=0); sequence parallelism does not apply to
+# single-token decode.
+# ---------------------------------------------------------------------------
+
+
+def _gen_weights(params):
+    """Generation-path view of ``params``: int8-quantized leaves (the
+    ``restore_for_inference(dtype="int8")`` wire format) dequantize here,
+    INSIDE the jitted forward — weights stay int8 in HBM and XLA fuses the
+    per-channel scale multiply into the consuming matmul."""
+    from ..ops.quant import dequantize_tree
+    return dequantize_tree(params)
+
+
+def _check_dense(cfg: TransformerConfig, what: str):
+    if cfg.n_experts:
+        raise NotImplementedError(
+            f"{what} supports dense FFNs only (cfg.n_experts="
+            f"{cfg.n_experts}); the MoE dispatch has no incremental-decode "
+            f"path yet")
+
+
+def init_kv_cache(cfg: TransformerConfig, max_slots: int, max_len: int,
+                  dtype: Any = None) -> Dict:
+    """Fresh per-layer K/V cache for ``max_slots`` concurrent sequences of
+    up to ``max_len`` tokens (prompt + generated).
+
+    Returns ``{"k", "v": [n_layers, max_slots, max_len, n_heads, d_head],
+    "lengths": [max_slots] int32}`` — ``lengths[s]`` is how many positions
+    of slot ``s`` hold real K/V. Rows beyond a slot's length are garbage by
+    contract (padded prefill writes land there) and are masked out of every
+    attention; a slot's row is rewritten by the next ``prefill`` into it,
+    so slots recycle without clearing."""
+    _check_dense(cfg, "init_kv_cache")
+    d_head = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, max_slots, max_len, cfg.n_heads, d_head)
+    kv_dtype = cfg.dtype if dtype is None else dtype
+    return {"k": jnp.zeros(shape, kv_dtype),
+            "v": jnp.zeros(shape, kv_dtype),
+            "lengths": jnp.zeros((max_slots,), jnp.int32)}
+
+
+def kv_cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    """PartitionSpec tree matching :func:`init_kv_cache`: the head axis
+    shards over ``tp`` (mirroring ``param_specs``' column-parallel wqkv —
+    each tp rank caches exactly the heads it computes); slots and
+    positions stay replicated."""
+    tp = "tp" if "tp" in _axes(mesh) else None
+    kv = P(None, None, None, tp, None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
+            length=None) -> Tuple[Dict, Any]:
+    """Run the full prompt through the model, writing every position's K/V
+    into ``cache`` at ``slot``.
+
+    Args:
+      tokens: [T] int32 prompt, optionally padded (``T`` is the compiled
+        bucket; any pad token id works — padded positions' K/V are written
+        but masked by ``length`` until real decode steps overwrite them).
+      slot: int32 scalar — which cache row to fill (traced, so one
+        compiled program serves every slot).
+      length: true prompt length (int32 scalar; defaults to ``T``).
+
+    Returns ``(cache', logits [T, vocab] f32)`` — logits at EVERY prompt
+    position, matching one-shot :func:`forward` (the parity contract
+    tests/test_generate.py pins); sampling reads row ``length - 1``.
+    Reads nothing from ``cache`` rows, so a prefill's logits are
+    independent of what other slots hold (the continuous-batching
+    invariance contract).
+    """
+    from ..ops.pallas_attention import flash_attention
+    _check_dense(cfg, "prefill")
+    params = _gen_weights(params)
+    T = tokens.shape[0]
+    if T > cache["k"].shape[2]:
+        raise ValueError(
+            f"prompt bucket {T} exceeds the cache max_len "
+            f"{cache['k'].shape[2]}")
+    length = jnp.asarray(T if length is None else length, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    d_head = cfg.d_model // cfg.n_heads
+    k_cache, v_cache = cache["k"], cache["v"]
+    x = params["embed"][tokens][None].astype(cfg.dtype)     # [1, T, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln1"])
+        qkv = h @ layer["wqkv"].astype(cfg.dtype)
+        qkv = qkv.reshape(1, T, cfg.n_heads, 3, d_head)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        zero = jnp.zeros((), jnp.int32)   # x64 mode: indices must agree
+        idx = (jnp.asarray(li, jnp.int32), slot, zero, zero, zero)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype)[None], idx)
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype)[None], idx)
+        attn = flash_attention(q, k, v, causal=True,
+                               backend=cfg.attn_backend).astype(cfg.dtype)
+        x = x + attn.reshape(1, T, cfg.n_heads * d_head) \
+            @ layer["wo"].astype(cfg.dtype)
+        h2 = _rms_norm(x, layer["ln2"])
+        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
+        x = x + up @ layer["w2"].astype(cfg.dtype)
+    x = _rms_norm(x, params["lnf"])
+    logits = jnp.matmul(x.astype(cfg.unembed_dtype),
+                        params["embed"].T.astype(cfg.unembed_dtype),
+                        preferred_element_type=jnp.float32)[0]
+    lengths = cache["lengths"].at[slot].set(length)
+    return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits
+
+
+def _cached_attention(q, k_cache, v_cache, positions):
+    """One query token per slot against that slot's cache row: q [S, H, d],
+    k/v_cache [S, M, H, d], positions [S] (index of the just-written
+    token; attends 0..position inclusive). Same numerics as the training
+    attention (f32 scores, 1/sqrt(d) scale, -1e30 mask, f32 softmax and
+    value matmul, cast back) — the prefill/decode parity depends on it."""
+    d = q.shape[-1]
+    s = jnp.einsum("shd,smhd->shm", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * (float(d) ** -0.5)
+    m = jnp.arange(k_cache.shape[1], dtype=jnp.int32)
+    s = jnp.where(m[None, None, :] <= positions[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shm,smhd->shd", p, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_step(params, last_tokens, cache: Dict, positions,
+                cfg: TransformerConfig) -> Tuple[Dict, Any]:
+    """One autoregressive step for every slot at once: embed each slot's
+    last sampled token, write its K/V at ``positions[s]``, attend over the
+    slot's cache (masked to ``<= positions[s]``), and return next-token
+    logits.
+
+    Args:
+      last_tokens: [S] int32 — per-slot previous token (S = max_slots; the
+        shape is FIXED, which is what makes continuous batching work: one
+        compiled program regardless of occupancy).
+      positions: [S] int32 — per-slot write index (== current length);
+        ``-1`` marks an inactive slot, whose output row is garbage to be
+        ignored (its scratch write lands at index 0 of a row that the next
+        prefill into that slot rewrites before it is ever read).
+
+    Returns ``(cache', logits [S, vocab] f32)``. Every per-slot row of the
+    computation depends only on that slot's token, position and cache row,
+    so a request's token stream is bit-identical whether it decodes alone
+    or alongside a full batch (the invariance tests/test_generate.py pins).
+    """
+    _check_dense(cfg, "decode_step")
+    params = _gen_weights(params)
+    S = last_tokens.shape[0]
+    d_head = cfg.d_model // cfg.n_heads
+    active = positions >= 0
+    pos = jnp.where(active, positions, 0).astype(jnp.int32)
+    rows = jnp.arange(S, dtype=jnp.int32)
+    k_cache, v_cache = cache["k"], cache["v"]
+    x = params["embed"][last_tokens].astype(cfg.dtype)      # [S, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln1"])
+        qkv = (h @ layer["wqkv"].astype(cfg.dtype)
+               ).reshape(S, cfg.n_heads, 3, d_head)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        k_cache = k_cache.at[li, rows, pos].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[li, rows, pos].set(v.astype(v_cache.dtype))
+        attn = _cached_attention(q, k_cache[li], v_cache[li], pos)
+        x = x + attn.reshape(S, cfg.n_heads * d_head) \
+            @ layer["wo"].astype(cfg.dtype)
+        h2 = _rms_norm(x, layer["ln2"])
+        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
+        x = x + up @ layer["w2"].astype(cfg.dtype)
+    x = _rms_norm(x, params["lnf"])
+    logits = jnp.matmul(x.astype(cfg.unembed_dtype),
+                        params["embed"].T.astype(cfg.unembed_dtype),
+                        preferred_element_type=jnp.float32)
+    lengths = jnp.where(active, pos + 1, cache["lengths"]
+                        ).astype(jnp.int32)
+    return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits
+
+
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
                              optimizer: optax.GradientTransformation,
                              aux_weight: float = 0.01,

@@ -14,6 +14,18 @@ guide.
                        variables, item_shape=(224, 224, 3))
     eng.warmup()
     logits = eng.infer(image)
+
+For the transformer LM, :class:`~.generate.GenerationEngine` adds
+continuous-batching KV-cache generation (requests join/leave the decode
+batch every step) with streaming token delivery:
+
+    params = serve.restore_for_inference(ckpt_dir, dtype="int8")["params"]
+    gen = serve.GenerationEngine(params, cfg,
+                                 serve.GenerationConfig(max_slots=8,
+                                                        max_len=512))
+    gen.warmup()
+    for tok in gen.submit(prompt_ids, max_new_tokens=64):
+        ...
 """
 
 from .batcher import (  # noqa: F401
@@ -24,9 +36,25 @@ from .batcher import (  # noqa: F401
     pad_rows,
 )
 from .engine import SERVE_PHASES, Engine, ServeConfig  # noqa: F401
+from .generate import (  # noqa: F401
+    GenerationConfig,
+    GenerationEngine,
+    GenerationHandle,
+    SamplingParams,
+    prefill_buckets,
+)
 from .metrics import ServeMetrics  # noqa: F401
 from .server import HttpServer  # noqa: F401
-from ..parallel.checkpoint import restore_for_inference  # noqa: F401
+from ..parallel.checkpoint import (  # noqa: F401
+    INFERENCE_DTYPES,
+    restore_for_inference,
+)
+from ..parallel.transformer import (  # noqa: F401
+    decode_step,
+    init_kv_cache,
+    kv_cache_specs,
+    prefill,
+)
 from ..exceptions import (  # noqa: F401
     DeadlineExceededError,
     ServerClosedError,

@@ -76,7 +76,28 @@ class ServeConfig:
     record_executed_batch: bool = False
 
 
-class Engine:
+class ReadinessMixin:
+    """The /healthz readiness contract shared by every serving engine
+    (:class:`Engine` and :class:`~.generate.GenerationEngine`): a triple
+    ``(ready, status, queue_depth)`` — ``(False, "warming", ...)`` until
+    :meth:`warmup` completes (a cold engine answers, but every first
+    bucket hit pays a compile — a load balancer must not route to it),
+    ``(False, "draining", ...)`` once shutdown began, ``(True, "ok", ...)``
+    otherwise. Hosts provide ``_warmed``/``_closed`` flags and a
+    ``_queue`` with ``__len__``."""
+
+    _warmed = False
+    _closed = False
+
+    def health(self) -> Tuple[bool, str, int]:
+        if self._closed:
+            return False, "draining", len(self._queue)
+        if not self._warmed:
+            return False, "warming", len(self._queue)
+        return True, "ok", len(self._queue)
+
+
+class Engine(ReadinessMixin):
     """In-process dynamic-batching inference server.
 
     Args:
@@ -172,19 +193,6 @@ class Engine:
                         f"split it back into per-request rows")
         self._warmed = True
         return self._buckets
-
-    def health(self) -> Tuple[bool, str, int]:
-        """Readiness triple ``(ready, status, queue_depth)`` for the
-        ``/healthz`` endpoint: ``(False, "warming", ...)`` until
-        :meth:`warmup` completes (a cold engine answers, but every first
-        bucket hit pays a compile — a load balancer must not route to
-        it), ``(False, "draining", ...)`` once :meth:`shutdown` began,
-        ``(True, "ok", ...)`` otherwise."""
-        if self._closed:
-            return False, "draining", len(self._queue)
-        if not self._warmed:
-            return False, "warming", len(self._queue)
-        return True, "ok", len(self._queue)
 
     # -- client API --------------------------------------------------------
 

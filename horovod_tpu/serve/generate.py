@@ -1,0 +1,563 @@
+"""Continuous-batching autoregressive generation over the KV-cache model
+layer (``parallel.transformer.prefill``/``decode_step``).
+
+The single-shot :class:`~.engine.Engine` batches whole requests; a
+generation workload cannot — requests finish at different times, and
+per-request batching would idle every slot until the slowest stream ends.
+This engine does Orca-style *iteration-level* scheduling over vLLM-style
+slot-managed KV memory instead:
+
+* **Slots, not batches.** The decode step always executes at the fixed
+  ``[max_slots]`` shape — ONE compiled program regardless of occupancy —
+  and requests join/leave the batch at every decode-step boundary. A new
+  request prefills into a free slot while its neighbors are mid-stream;
+  a finished request frees its slot without anyone else noticing. Slot
+  rows are numerically independent (each row of every matmul / softmax /
+  cache read-write depends only on that row), so a request's token stream
+  is **bit-identical** whether it runs alone or joins a busy batch — the
+  invariance contract tests/test_generate.py pins.
+* **Compile cache** (the PR-2 pattern): one AOT-compiled decode
+  executable for the engine's (max_slots, max_len), plus one prefill
+  executable per power-of-two prompt bucket; :meth:`GenerationEngine.
+  warmup` pre-compiles and pre-executes all of them so no user request
+  ever pays a compile.
+* **Sampling is per-request and host-side**: greedy / temperature /
+  top-k, each request seeded with its own ``numpy`` Generator so a
+  stream is reproducible no matter what shares its batch.
+* **Backpressure carries over from PR 2** unchanged: bounded admission
+  queue (:class:`~horovod_tpu.exceptions.ServerOverloadedError` at the
+  door), deadlines checked when a request is dequeued into a slot
+  (:class:`~horovod_tpu.exceptions.DeadlineExceededError` through the
+  handle), graceful drain on shutdown, ``/healthz`` readiness via
+  :class:`~.engine.ReadinessMixin`.
+
+The loop is one background thread: the decode step is a single
+accelerator program, and one consumer keeps slot assignment and the
+queue's FIFO semantics trivially correct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as std_queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import (DeadlineExceededError, ServerClosedError,
+                          ServerOverloadedError)
+from ..parallel.transformer import (TransformerConfig, decode_step,
+                                    init_kv_cache, prefill)
+from .batcher import RequestQueue, bucket_for
+from .engine import ReadinessMixin
+from .metrics import ServeMetrics
+
+_DEFAULT = object()    # "knob not passed" sentinel (None is a real value)
+
+
+def prefill_buckets(max_len: int) -> Tuple[int, ...]:
+    """Prompt-padding buckets: powers of two below ``max_len``, topped by
+    ``max_len`` itself — so the compile cache is ``log2(max_len)+1``
+    programs and every bucket fits the cache."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    sizes: List[int] = []
+    b = 1
+    while b < max_len:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_len)
+    return tuple(sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs. ``temperature <= 0`` is greedy (argmax;
+    ``top_k``/``seed`` ignored). ``top_k=0`` samples the full vocab.
+    ``seed`` makes the stream reproducible: the request owns a private
+    ``numpy`` Generator, so identical (prompt, params, seed) produce an
+    identical stream regardless of what else shares the batch."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Engine knobs. ``max_slots`` is the decode batch width (the number
+    of concurrently generating requests) and ``max_len`` the KV-cache
+    depth (prompt + generated tokens per request) — together they size
+    the cache: ``2 · n_layers · max_slots · max_len · d_model`` elements.
+    The rest mirrors :class:`~.engine.ServeConfig`'s backpressure
+    contract."""
+
+    max_slots: int = 8
+    max_len: int = 512
+    max_queue: int = 256
+    default_deadline_ms: Optional[float] = None
+    default_max_new_tokens: int = 64
+    eos_id: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if self.max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {self.max_len}")
+        if self.default_max_new_tokens < 1:
+            raise ValueError("default_max_new_tokens must be >= 1")
+
+
+class GenerationHandle:
+    """Streaming result of one generation request.
+
+    Consume incrementally (``for tok in handle: ...`` yields token ids as
+    they are sampled; raises the failure exception if the request dies)
+    or wait for completion: ``handle.result(timeout)`` returns
+    ``{"tokens", "finish_reason" ("eos"|"length"), "n_tokens",
+    "ttft_ms", "tokens_per_sec"}``. Both can be used together — the
+    iterator drains a private event queue, ``result`` reads the
+    accumulated state.
+    """
+
+    def __init__(self):
+        self._events: std_queue.Queue = std_queue.Queue()
+        self._done = threading.Event()
+        self._tokens: List[int] = []
+        self._error: Optional[BaseException] = None
+        self._info: Optional[Dict] = None
+        self.request: Any = None    # the engine's _GenRequest (debug/test)
+
+    # -- engine side -------------------------------------------------------
+
+    def _emit(self, tok: int) -> None:
+        self._tokens.append(tok)
+        self._events.put(("token", tok))
+
+    def _finish(self, info: Dict) -> None:
+        self._info = info
+        self._done.set()
+        self._events.put(("done", info))
+
+    def _fail(self, exc: BaseException) -> None:
+        if self._done.is_set():
+            return
+        self._error = exc
+        self._done.set()
+        self._events.put(("error", exc))
+
+    # -- client side -------------------------------------------------------
+
+    def next_event(self, timeout: Optional[float] = None):
+        """``("token", id)`` / ``("done", info)`` / ``("error", exc)`` in
+        emission order; raises ``queue.Empty`` on timeout."""
+        return self._events.get(timeout=timeout)
+
+    def __iter__(self):
+        while True:
+            kind, val = self._events.get()
+            if kind == "token":
+                yield val
+            elif kind == "done":
+                return
+            else:
+                raise val
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"generation not finished within {timeout} s")
+        if self._error is not None:
+            raise self._error
+        return dict(self._info)
+
+
+@dataclasses.dataclass
+class _GenRequest:
+    """One queued/in-flight generation request."""
+
+    tokens: np.ndarray               # [L] int32 prompt
+    max_new: int
+    sampling: SamplingParams
+    eos: Optional[int]
+    handle: GenerationHandle
+    enqueued_at: float               # time.monotonic()
+    deadline_at: Optional[float]
+    rng: np.random.Generator
+    n_out: int = 0
+    t_admit: Optional[float] = None     # dequeued into a slot
+    t_first: Optional[float] = None     # first token sampled
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_at is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline_at
+
+    def sample(self, logits: np.ndarray) -> int:
+        t = self.sampling.temperature
+        if t <= 0:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / float(t)
+        k = self.sampling.top_k
+        keep = None
+        if k and k < x.size:
+            keep = np.argpartition(x, -k)[-k:]
+            x = x[keep]
+        e = np.exp(x - np.max(x))
+        p = e / e.sum()
+        j = int(self.rng.choice(p.size, p=p))
+        return int(keep[j]) if keep is not None else j
+
+
+class GenerationEngine(ReadinessMixin):
+    """Continuous-batching generation server over one transformer.
+
+    Args:
+      params: the ``parallel.transformer`` param pytree — from
+        ``init_params``, or ``restore_for_inference(..., dtype=)`` (plain
+        fp32/bf16 leaves, or int8 :class:`~horovod_tpu.ops.quant.
+        QuantizedTensor` leaves, dequantized inside the compiled forward).
+        Pre-sharded global ``jax.Array`` leaves serve as laid out.
+      model_cfg: the :class:`~horovod_tpu.parallel.transformer.
+        TransformerConfig` the params belong to (dense FFN only).
+      config: :class:`GenerationConfig`.
+    """
+
+    def __init__(self, params: Any, model_cfg: TransformerConfig,
+                 config: GenerationConfig = GenerationConfig()):
+        if model_cfg.n_experts:
+            raise NotImplementedError(
+                "generation supports dense FFNs only (n_experts=0)")
+        self._params = params
+        self._model_cfg = model_cfg
+        self._cfg = config
+        self._queue = RequestQueue(config.max_queue)
+        self._metrics = ServeMetrics()
+        self._cache = init_kv_cache(model_cfg, config.max_slots,
+                                    config.max_len)
+        self._buckets = prefill_buckets(config.max_len)
+        s = config.max_slots
+        self._slots: List[Optional[_GenRequest]] = [None] * s
+        self._positions = np.full((s,), -1, np.int32)
+        self._last = np.zeros((s,), np.int32)
+        self._compiled: Dict[Any, Any] = {}
+        self._compile_lock = threading.Lock()
+        # Mirrored under a micro-lock so stats() never waits on a compile
+        # (same reasoning as Engine._compiled_ids).
+        self._compiled_ids: set = set()
+        self._stats_lock = threading.Lock()
+        self._closed = False
+        self._warmed = False
+        self._abort = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd-generate-loop",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- compile cache -----------------------------------------------------
+
+    def _sds(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           np.asarray(x).dtype
+                                           if not hasattr(x, "dtype")
+                                           else x.dtype), tree)
+
+    def _compile(self, key):
+        """AOT-compile the ``key`` executable (idempotent): ``"decode"``
+        or ``("prefill", bucket)``."""
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(key)
+            if exe is None:
+                cfg = self._model_cfg
+                s = self._cfg.max_slots
+                p_sds = self._sds(self._params)
+                c_sds = self._sds(self._cache)
+                i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+                if key == "decode":
+                    def _decode(p, toks, c, pos):
+                        return decode_step(p, toks, c, pos, cfg)
+                    exe = (jax.jit(_decode)
+                           .lower(p_sds, i32(s), c_sds, i32(s)).compile())
+                else:
+                    t = key[1]
+
+                    def _prefill(p, toks, c, slot, length):
+                        c2, logits = prefill(p, toks, c, slot, cfg,
+                                             length=length)
+                        # Only the sampled row crosses back to the host —
+                        # [vocab], not [T, vocab].
+                        return c2, logits[length - 1]
+                    exe = (jax.jit(_prefill)
+                           .lower(p_sds, i32(t), c_sds, i32(), i32())
+                           .compile())
+                self._compiled[key] = exe
+                with self._stats_lock:
+                    self._compiled_ids.add(
+                        key if key == "decode" else f"prefill_{key[1]}")
+        return exe
+
+    def warmup(self) -> Tuple[Any, ...]:
+        """Pre-compile AND pre-execute the decode step and every prefill
+        bucket before traffic (the cache is functional state — warmup
+        outputs are discarded, so it stays pristine). Returns the keys
+        warmed."""
+        s = self._cfg.max_slots
+        out = self._compile("decode")(
+            self._params, np.zeros((s,), np.int32), self._cache,
+            np.full((s,), -1, np.int32))
+        jax.block_until_ready(out)
+        for t in self._buckets:
+            out = self._compile(("prefill", t))(
+                self._params, np.zeros((t,), np.int32), self._cache,
+                np.asarray(0, np.int32), np.asarray(1, np.int32))
+            jax.block_until_ready(out)
+        self._warmed = True
+        return ("decode",) + tuple(self._buckets)
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, tokens: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None,
+               eos_id: Any = _DEFAULT,
+               deadline_ms: Optional[float] = None) -> GenerationHandle:
+        """Enqueue one prompt; returns a :class:`GenerationHandle`
+        streaming the sampled tokens. Raises
+        :class:`ServerOverloadedError` when the admission queue is full,
+        :class:`ServerClosedError` after shutdown, ``ValueError`` on a
+        malformed or cache-overflowing prompt (all eagerly, in the
+        caller's thread).
+
+        ``max_new_tokens`` is clamped to the cache room left after the
+        prompt (the stream then finishes with reason ``"length"``);
+        ``eos_id=None`` disables EOS for this request even when the
+        engine has a default.
+        """
+        toks = np.asarray(tokens, np.int32)
+        if toks.ndim != 1 or toks.size == 0:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D int sequence, got shape "
+                f"{toks.shape}")
+        if toks.size > self._cfg.max_len:
+            raise ValueError(
+                f"prompt of {toks.size} tokens exceeds max_len="
+                f"{self._cfg.max_len} (prompt + generated tokens share "
+                f"the KV cache)")
+        max_new = (self._cfg.default_max_new_tokens
+                   if max_new_tokens is None else int(max_new_tokens))
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        # Token t+1's K/V lands at position L+t; the last sampled token
+        # needs no cache write, so room caps new tokens at max_len-L+1.
+        max_new = min(max_new, self._cfg.max_len - toks.size + 1)
+        sampling = SamplingParams() if sampling is None else sampling
+        eos = self._cfg.eos_id if eos_id is _DEFAULT else eos_id
+        if deadline_ms is None:
+            deadline_ms = self._cfg.default_deadline_ms
+        now = time.monotonic()
+        handle = GenerationHandle()
+        req = _GenRequest(
+            tokens=toks, max_new=max_new, sampling=sampling, eos=eos,
+            handle=handle, enqueued_at=now,
+            deadline_at=(None if deadline_ms is None
+                         else now + deadline_ms / 1e3),
+            rng=np.random.default_rng(sampling.seed))
+        handle.request = req
+        try:
+            depth = self._queue.put(req)    # raises Closed / Overloaded
+        except ServerOverloadedError:
+            self._metrics.on_overload()
+            raise
+        self._metrics.on_submit(depth)
+        return handle
+
+    def generate(self, tokens: Sequence[int],
+                 timeout: Optional[float] = None, **kw) -> Dict:
+        """Synchronous :meth:`submit` (+ ``handle.result(timeout)``)."""
+        return self.submit(tokens, **kw).result(timeout)
+
+    def stats(self) -> Dict:
+        """The ``/stats`` snapshot (augments :class:`ServeMetrics` with
+        the slot/compile view; ``batch_fill_ratio`` here is decode-slot
+        occupancy — live streams ÷ slots executed)."""
+        snap = self._metrics.snapshot()
+        snap["max_slots"] = self._cfg.max_slots
+        snap["max_len"] = self._cfg.max_len
+        snap["active_slots"] = sum(r is not None for r in self._slots)
+        snap["prefill_buckets"] = list(self._buckets)
+        with self._stats_lock:
+            snap["compiled"] = sorted(map(str, self._compiled_ids))
+        snap["max_queue"] = self._cfg.max_queue
+        return snap
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the engine. ``drain=True`` finishes every stream already
+        admitted (queued AND mid-generation) first; ``drain=False`` fails
+        pending handles with :class:`ServerClosedError` and aborts
+        in-flight streams. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain:
+            self._queue.close()
+        else:
+            self._abort = True
+            self._fail_pending()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        cancelled = 0
+        for req in self._queue.drain_pending():
+            if not req.handle.done():
+                req.handle._fail(ServerClosedError(
+                    "server shut down before execution"))
+                cancelled += 1
+        if cancelled:
+            self._metrics.on_shutdown_cancel(cancelled)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- the continuous-batching loop --------------------------------------
+
+    def _loop(self):
+        while True:
+            try:
+                if self._abort:
+                    self._fail_active(ServerClosedError(
+                        "server shut down before completion"))
+                    return
+                free = [i for i, r in enumerate(self._slots) if r is None]
+                n_active = self._cfg.max_slots - len(free)
+                if free and (n_active == 0 or len(self._queue)):
+                    # Blocks ONLY when fully idle (no active streams and
+                    # an empty queue); with streams in flight it drains
+                    # whatever is queued without waiting.
+                    batch = self._queue.take_batch(len(free), 0.0)
+                    if not batch and n_active == 0:
+                        return      # closed and drained, nothing in flight
+                    for req in batch:
+                        slot = free.pop(0)
+                        if not self._admit(req, slot):
+                            free.insert(0, slot)
+                if any(r is not None for r in self._slots):
+                    self._decode_once()
+            except Exception as e:  # noqa: BLE001 — deliver, don't die
+                self._fail_active(e)
+
+    def _fail_active(self, exc: BaseException) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.handle._fail(exc)
+                self._slots[i] = None
+                self._positions[i] = -1
+
+    def _admit(self, req: _GenRequest, slot: int) -> bool:
+        """Prefill ``req`` into ``slot`` and emit its first token; returns
+        True iff the slot is now occupied (a request that expires in the
+        queue, fails, or finishes on its first token never occupies)."""
+        now = time.monotonic()
+        if req.expired(now):
+            self._metrics.on_deadline_expired(
+                (now - req.enqueued_at) * 1e3)
+            req.handle._fail(DeadlineExceededError(
+                f"deadline expired after "
+                f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
+            return False
+        req.t_admit = now
+        try:
+            length = int(req.tokens.size)
+            bucket = bucket_for(length, self._buckets)
+            toks = np.zeros((bucket,), np.int32)
+            toks[:length] = req.tokens
+            exe = self._compile(("prefill", bucket))
+            cache, last_logits = exe(
+                self._params, toks, self._cache,
+                np.asarray(slot, np.int32), np.asarray(length, np.int32))
+            logits = np.asarray(last_logits)    # blocks
+        except Exception as e:  # noqa: BLE001
+            req.handle._fail(e)
+            return False
+        self._cache = cache
+        req.t_first = time.monotonic()
+        self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3)
+        tok = req.sample(logits)
+        req.n_out = 1
+        self._metrics.on_tokens()
+        req.handle._emit(tok)
+        reason = self._finish_reason(req, tok, next_pos=length)
+        if reason:
+            self._finish(req, reason)
+            return False
+        self._slots[slot] = req
+        self._positions[slot] = length
+        self._last[slot] = tok
+        return True
+
+    def _decode_once(self) -> None:
+        t0 = time.monotonic()
+        cache, logits = self._compile("decode")(
+            self._params, self._last.copy(), self._cache,
+            self._positions.copy())
+        logits_np = np.asarray(logits)          # blocks
+        self._cache = cache
+        exec_ms = (time.monotonic() - t0) * 1e3
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        self._metrics.on_batch(self._cfg.max_slots, len(active), exec_ms,
+                               len(self._queue))
+        for i in active:
+            req = self._slots[i]
+            tok = req.sample(logits_np[i])
+            req.n_out += 1
+            self._metrics.on_tokens()
+            req.handle._emit(tok)
+            self._positions[i] += 1
+            self._last[i] = tok
+            reason = self._finish_reason(req, tok,
+                                         next_pos=int(self._positions[i]))
+            if reason:
+                self._finish(req, reason)
+                self._slots[i] = None
+                self._positions[i] = -1
+
+    def _finish_reason(self, req: _GenRequest, tok: int,
+                       next_pos: int) -> Optional[str]:
+        if req.eos is not None and tok == req.eos:
+            return "eos"
+        if req.n_out >= req.max_new or next_pos >= self._cfg.max_len:
+            return "length"
+        return None
+
+    def _finish(self, req: _GenRequest, reason: str) -> None:
+        now = time.monotonic()
+        gen_s = now - req.t_first
+        ttft_ms = (req.t_first - req.enqueued_at) * 1e3
+        self._metrics.on_generation_end(req.n_out, gen_s)
+        # queue_ms is the ADMISSION wait (enqueue → slot), not TTFT —
+        # latency.queue_* must isolate queue pressure from prefill cost.
+        self._metrics.on_response((now - req.enqueued_at) * 1e3,
+                                  (req.t_admit - req.enqueued_at) * 1e3)
+        req.handle._finish({
+            "tokens": list(req.handle._tokens),
+            "finish_reason": reason,
+            "n_tokens": req.n_out,
+            "ttft_ms": ttft_ms,
+            "tokens_per_sec": ((req.n_out - 1) / gen_s
+                               if req.n_out > 1 and gen_s > 0 else None),
+        })

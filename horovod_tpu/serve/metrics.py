@@ -77,6 +77,12 @@ class ServeMetrics:
         self._request_ms = _Reservoir()
         self._queue_ms = _Reservoir(seed=1)
         self._execute_ms = _Reservoir(seed=2)
+        # Generation-plane recorders (the continuous-batching engine):
+        # unused by the single-shot Engine, zero/None in its snapshot.
+        self.generations_total = 0
+        self.tokens_generated_total = 0
+        self._ttft_ms = _Reservoir(seed=3)
+        self._tps_user = _Reservoir(seed=4)
 
     # -- producers ---------------------------------------------------------
 
@@ -113,6 +119,28 @@ class ServeMetrics:
             self._request_ms.add(request_ms)
             self._queue_ms.add(queue_ms)
 
+    # -- generation plane ----------------------------------------------------
+
+    def on_first_token(self, ttft_ms: float) -> None:
+        """Time-to-first-token: submit → the prefill's sampled token. The
+        latency a generation user actually perceives as 'responsiveness'
+        — decode throughput is a separate number (below)."""
+        with self._lock:
+            self._ttft_ms.add(ttft_ms)
+
+    def on_tokens(self, n: int = 1) -> None:
+        with self._lock:
+            self.tokens_generated_total += n
+
+    def on_generation_end(self, n_tokens: int, seconds: float) -> None:
+        """One finished request: records its tokens/sec-per-user (first
+        token → last token — the per-stream decode rate, not aggregate
+        throughput; a busy batch lowers it while raising the aggregate)."""
+        with self._lock:
+            self.generations_total += 1
+            if n_tokens > 1 and seconds > 0:
+                self._tps_user.add((n_tokens - 1) / seconds)
+
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> Dict:
@@ -141,5 +169,18 @@ class ServeMetrics:
                     "queue_p99": self._queue_ms.quantile(0.99),
                     "execute_p50": self._execute_ms.quantile(0.50),
                     "execute_p99": self._execute_ms.quantile(0.99),
+                    # Generation-plane percentiles, next to the request
+                    # latencies an operator already reads (None until a
+                    # generation engine records into this snapshot).
+                    "ttft_p50": self._ttft_ms.quantile(0.50),
+                    "ttft_p99": self._ttft_ms.quantile(0.99),
+                },
+                "generation": {
+                    "generations_total": self.generations_total,
+                    "tokens_generated_total": self.tokens_generated_total,
+                    "ttft_p50": self._ttft_ms.quantile(0.50),
+                    "ttft_p99": self._ttft_ms.quantile(0.99),
+                    "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
+                    "tokens_per_sec_user_p99": self._tps_user.quantile(0.99),
                 },
             }

@@ -12,6 +12,13 @@ status codes so clients see conventional semantics:
 * deadline expired (:class:`DeadlineExceededError`) → **504**
 * shut down (:class:`ServerClosedError`) → 503 with a terminal hint
 * bad shape/JSON → 400
+* ``POST /generate`` (when a :class:`~.generate.GenerationEngine` is
+  attached) → 200 with **chunked** streaming: one JSON line per sampled
+  token (``{"token": 17}``) the moment the engine emits it, then a final
+  ``{"done": true, "finish_reason": ..., ...}`` line. ``"stream": false``
+  buffers into one ``{"tokens": [...], ...}`` object. Backpressure maps
+  exactly as ``/predict`` (the stream only starts once the first token
+  exists, so deadline/overload failures still get real status codes).
 * ``GET /stats`` → 200, the engine's snapshot dict as JSON
 * ``GET /healthz`` → readiness probe: **503** before ``warmup()``
   completes and once drain/shutdown begins, 200 with the current queue
@@ -35,7 +42,11 @@ from .engine import Engine
 
 
 class _Handler(BaseHTTPRequestHandler):
-    engine: Engine = None  # installed by HttpServer
+    engine: Engine = None        # installed by HttpServer
+    gen_engine = None            # optional GenerationEngine
+    # HTTP/1.1 for Transfer-Encoding: chunked (the /generate stream);
+    # every non-chunked reply carries Content-Length, so keep-alive works.
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):  # quiet: the engine's metrics are the log
         pass
@@ -48,19 +59,116 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _primary(self):
+        """The engine whose health/stats this server reports: the
+        single-shot engine when present, else the generation engine."""
+        return self.engine if self.engine is not None else self.gen_engine
+
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/stats":
-            self._reply(200, self.engine.stats())
+            snap = self._primary().stats()
+            if self.engine is not None and self.gen_engine is not None:
+                snap["generate"] = self.gen_engine.stats()
+            self._reply(200, snap)
         elif path == "/healthz":
-            ready, status, depth = self.engine.health()
+            ready, status, depth = self._primary().health()
+            if ready and self.gen_engine is not None \
+                    and self.gen_engine is not self._primary():
+                ready, status, depth = self.gen_engine.health()
             self._reply(200 if ready else 503,
                         {"status": status, "queue_depth": depth})
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 
+    # -- generation streaming ----------------------------------------------
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+    def _do_generate(self):
+        if self.gen_engine is None:
+            self._reply(404, {"error": "no generation engine attached"})
+            return
+        from .generate import SamplingParams
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError(
+                    f"body must be a JSON object, got {type(req).__name__}")
+            tokens = [int(t) for t in req["tokens"]]
+            sampling = SamplingParams(
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                seed=int(req.get("seed", 0)))
+            kw = {}
+            if req.get("max_new_tokens") is not None:
+                kw["max_new_tokens"] = int(req["max_new_tokens"])
+            if "eos" in req:
+                kw["eos_id"] = (None if req["eos"] is None
+                                else int(req["eos"]))
+            if req.get("deadline_ms") is not None:
+                kw["deadline_ms"] = float(req["deadline_ms"])
+            stream = bool(req.get("stream", True))
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        streaming = False
+        try:
+            handle = self.gen_engine.submit(tokens, sampling=sampling, **kw)
+            if not stream:
+                self._reply(200, handle.result())
+                return
+            # Hold the headers until the first event: a request that dies
+            # in the queue (deadline/shutdown) still gets a real status
+            # code instead of a 200 that breaks mid-stream.
+            kind, val = handle.next_event()
+            if kind == "error":
+                raise val
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            streaming = True
+            while True:
+                if kind == "token":
+                    self._chunk(json.dumps({"token": val}).encode() + b"\n")
+                elif kind == "done":
+                    done = dict(val)
+                    done["done"] = True
+                    self._chunk(json.dumps(done).encode() + b"\n")
+                    break
+                else:   # error after tokens already streamed: terminal line
+                    self._chunk(json.dumps(
+                        {"error": repr(val), "done": True}).encode() + b"\n")
+                    break
+                kind, val = handle.next_event()
+            self._chunk(b"")    # 0-length chunk terminates the stream
+        except ServerOverloadedError as e:
+            self._reply(503, {"error": str(e), "retryable": True})
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+        except ServerClosedError as e:
+            self._reply(503, {"error": str(e), "retryable": False})
+        except ValueError as e:
+            self._reply(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — the engine funnels ALL its
+            # failures (XLA runtime errors included) into the handle, so
+            # arbitrary exception types re-raise here; without this the
+            # client sees a connection reset instead of a status code.
+            if streaming:
+                raise   # headers already sent: let the server close the
+                        # socket (the in-loop error branch covers handle
+                        # failures; only wfile errors reach here)
+            self._reply(500, {"error": f"generation failed: {e!r}"})
+
     def do_POST(self):
-        if self.path != "/predict":
+        if self.path == "/generate":
+            self._do_generate()
+            return
+        if self.path != "/predict" or self.engine is None:
             self._reply(404, {"error": f"no such path {self.path}"})
             return
         try:
@@ -91,15 +199,25 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    """Serve an :class:`Engine` over HTTP on a background thread.
+    """Serve an :class:`Engine` (and/or a
+    :class:`~.generate.GenerationEngine` via ``generate=``) over HTTP on
+    a background thread. With both attached, ``/predict`` hits the
+    single-shot engine and ``/generate`` the generation engine;
+    ``/healthz`` is ready only when every attached engine is, and
+    ``/stats`` nests the generation snapshot under ``"generate"``.
 
     ``port=0`` binds an ephemeral port (read it back from ``.port``) —
     the test-friendly default.
     """
 
-    def __init__(self, engine: Engine, host: str = "127.0.0.1",
-                 port: int = 0):
-        handler = type("BoundHandler", (_Handler,), {"engine": engine})
+    def __init__(self, engine: Optional[Engine] = None,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 generate=None):
+        if engine is None and generate is None:
+            raise ValueError(
+                "HttpServer needs an engine= and/or a generate= engine")
+        handler = type("BoundHandler", (_Handler,),
+                       {"engine": engine, "gen_engine": generate})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
