@@ -16,10 +16,23 @@ expiries).
 ``--mode generate`` drives the continuous-batching generation engine
 instead (a small transformer LM, mixed prompt lengths): per operating
 point it reports p50/p99 **time-to-first-token**, per-user and aggregate
-tokens/sec, and decode-slot occupancy.
+tokens/sec, and decode-slot occupancy — and prints one JSON line per
+point (``peak_bytes_per_chip`` from the same ``memory_stats`` probe
+``bench.py`` uses, KV-cache bytes, peak concurrent streams, block-pool
+and prefix-cache gauges) so the fixed-HBM capacity claims are checkable
+from the bench row. ``--json FILE`` additionally appends the lines to a
+file (the ci.sh capacity/prefix legs parse it).
 
     JAX_PLATFORMS=cpu python bin/serve_bench.py --mode generate \
         --qps 20 --duration 5
+
+``--kv-layout paged`` (with ``--block-size``/``--n-blocks``/
+``--prefix-reuse``/``--prefix-tokens``) serves the paged KV cache;
+``--cache-mb`` fixes the KV-cache byte budget and derives the layout's
+capacity from it (contiguous: slots = budget ÷ full-depth reservation;
+paged: pool = budget ÷ block bytes, slots = what the pool can hold of
+typical requests) — the concurrent-streams-capacity comparison at equal
+cache bytes.
 
 Exit status is nonzero if any *in-deadline* request was dropped at the
 configured operating point — the regression gate ci.sh's serve smokes
@@ -41,6 +54,60 @@ import numpy as np  # noqa: E402
 
 def _percentile(xs, q):
     return float(np.percentile(xs, q * 100)) if xs else float("nan")
+
+
+def _peak_bytes_per_chip():
+    """Per-chip peak HBM bytes from the runtime's allocator stats, or
+    None where the backend keeps none (CPU) — the same probe bench.py
+    records, so the fixed-HBM capacity claim is checkable from the JSON
+    row."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — stats are best-effort telemetry
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
+# The generate-mode bench model (vocab/d_model/heads/layers below):
+# bytes per cached token position = 2 (K and V) · n_layers · d_model · 4
+# (f32) — the unit both layouts' capacity math is written in.
+_GEN_MODEL = dict(vocab=256, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+_GEN_BYTES_PER_TOKEN = 2 * _GEN_MODEL["n_layers"] * _GEN_MODEL["d_model"] * 4
+
+
+def _gen_capacity(args):
+    """Resolve (max_slots, n_blocks, cache_bytes) for the generate
+    engine. With ``--cache-mb`` the budget is FIXED and capacity derives
+    from the layout — the whole point of the paged comparison:
+
+    * contiguous: each slot reserves ``max_len`` positions, so
+      slots = budget // (max_len · bytes/token);
+    * paged: the pool is budget // (block_size · bytes/token) blocks —
+      the reserved trash block is charged AGAINST the budget (usable
+      capacity is one block less), not added on top — and slots = how
+      many TYPICAL requests (longest bench prompt + generated tokens)
+      the usable pool holds, capped at 64 so the decode program stays
+      small on a CPU host.
+    """
+    if not args.cache_mb:
+        n_blocks = args.n_blocks if args.n_blocks else None
+        return args.slots, n_blocks, None
+    budget = int(args.cache_mb * 2 ** 20)
+    if args.kv_layout == "contiguous":
+        slots = max(1, budget // (args.max_len * _GEN_BYTES_PER_TOKEN))
+        return slots, None, slots * args.max_len * _GEN_BYTES_PER_TOKEN
+    block_bytes = args.block_size * _GEN_BYTES_PER_TOKEN
+    n_blocks = max(2, budget // block_bytes)
+    # Typical request: the longest bench prompt (prefix + 16) plus the
+    # generated tokens (the last sampled token needs no cache write).
+    typical = args.prefix_tokens + 16 + args.gen_tokens - 1
+    per_req = -(-typical // args.block_size)
+    slots = max(1, min(64, (n_blocks - 1) // per_req))
+    return slots, n_blocks, n_blocks * block_bytes
 
 
 def _build_engine(args):
@@ -90,19 +157,32 @@ def _build_gen_engine(args):
 
     # Small but real: the bench measures the serving plane (slot churn,
     # prefill/decode interleave, streaming), not model quality.
-    cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
-                            d_ff=128, dtype=jnp.float32,
+    cfg = TransformerConfig(**_GEN_MODEL, dtype=jnp.float32,
                             unembed_dtype=jnp.float32, attn_backend="xla")
     params = init_params(jax.random.PRNGKey(0), cfg)
+    slots, n_blocks, cache_bytes = _gen_capacity(args)
     gcfg = serve.GenerationConfig(
-        max_slots=args.slots, max_len=args.max_len,
+        max_slots=slots, max_len=args.max_len,
         max_queue=args.max_queue, default_deadline_ms=args.deadline_ms,
-        default_max_new_tokens=args.gen_tokens)
+        default_max_new_tokens=args.gen_tokens,
+        kv_layout=args.kv_layout,
+        **({"block_size": args.block_size, "n_blocks": n_blocks,
+            "prefix_reuse": args.prefix_reuse,
+            "paged_kernel": args.paged_kernel}
+           if args.kv_layout == "paged" else {}))
+    if cache_bytes is None:
+        if args.kv_layout == "paged":
+            cache_bytes = (gcfg.resolved_n_blocks * gcfg.block_size
+                           * _GEN_BYTES_PER_TOKEN)
+        else:
+            cache_bytes = slots * args.max_len * _GEN_BYTES_PER_TOKEN
     eng = serve.GenerationEngine(params, cfg, gcfg)
+    eng.bench_cache_bytes = cache_bytes      # stamped into the JSON rows
     t0 = time.monotonic()
     warmed = eng.warmup()
-    print(f"warmup: decode + {len(warmed) - 1} prefill buckets "
-          f"pre-compiled in {time.monotonic() - t0:.2f} s")
+    print(f"warmup [{args.kv_layout}, slots={slots}]: decode + "
+          f"{len(warmed) - 1} prefill buckets pre-compiled in "
+          f"{time.monotonic() - t0:.2f} s")
     return eng
 
 
@@ -110,11 +190,19 @@ def run_gen_point(eng, qps: float, duration: float,
                   rng: np.random.RandomState, args) -> dict:
     """One generation operating point: open-loop prompt arrivals; TTFT
     and per-user tokens/sec come from the engine-stamped result dicts
-    (submit → first token / first → last token)."""
+    (submit → first token / first → last token). ``--prefix-tokens N``
+    prepends a fixed N-token system prompt to every request (the
+    traffic-class shape ``--prefix-reuse`` amortizes)."""
+    import hashlib
+
     from horovod_tpu.exceptions import (DeadlineExceededError,
                                         ServerOverloadedError)
     n = max(1, int(qps * duration))
     period = 1.0 / qps
+    # Deterministic across runs and independent of the arrival RNG, so
+    # reuse-on vs reuse-off runs see the SAME system prompt.
+    sys_prefix = np.random.RandomState(1234).randint(
+        1, 255, size=args.prefix_tokens).tolist()
     handles = []
     overload = 0
     start = time.monotonic()
@@ -122,18 +210,21 @@ def run_gen_point(eng, qps: float, duration: float,
         delay = start + i * period - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        prompt = rng.randint(1, 255, size=rng.randint(4, 17)).tolist()
+        prompt = sys_prefix + rng.randint(
+            1, 255, size=rng.randint(4, 17)).tolist()
         try:
             handles.append(eng.submit(prompt))
         except ServerOverloadedError:
             overload += 1
     ttft_ms, tps_user, tokens_out = [], [], 0
     expired, failed = 0, 0
+    streams = []
     for h in handles:
         try:
             r = h.result(timeout=120)
             ttft_ms.append(r["ttft_ms"])
             tokens_out += r["n_tokens"]
+            streams.append(tuple(r["tokens"]))
             if r["tokens_per_sec"] is not None:
                 tps_user.append(r["tokens_per_sec"])
         except DeadlineExceededError:
@@ -142,7 +233,13 @@ def run_gen_point(eng, qps: float, duration: float,
             failed += 1
     wall = time.monotonic() - start
     snap = eng.stats()
-    return {
+    # Completion-order-free digest of every completed stream: identical
+    # prompts + greedy sampling must give an identical digest whatever
+    # the batch composition was — the ci.sh prefix-reuse leg pins
+    # reuse-on == reuse-off through this field.
+    digest = hashlib.sha256(repr(sorted(streams)).encode()).hexdigest()
+    gen = snap["generation"]
+    row = {
         "qps_target": qps,
         "sent": n,
         "completed": len(ttft_ms),
@@ -154,7 +251,24 @@ def run_gen_point(eng, qps: float, duration: float,
         "deadline_drops": expired,
         "failed": failed,
         "slot_fill": snap["batch_fill_ratio"],
+        # Capacity / memory telemetry (the fixed-HBM claims):
+        "kv_layout": snap["kv_layout"],
+        "max_slots": snap["max_slots"],
+        "max_len": snap["max_len"],
+        "cache_bytes": getattr(eng, "bench_cache_bytes", None),
+        "peak_concurrent_streams": snap["peak_active_slots"],
+        "peak_bytes_per_chip": _peak_bytes_per_chip(),
+        "rejected_slots_full": snap["rejected_slots_full"],
+        "rejected_blocks_exhausted": snap["rejected_blocks_exhausted"],
+        "prefix_hits_total": gen["prefix_hits_total"],
+        "prefix_misses_total": gen["prefix_misses_total"],
+        "prefix_hit_blocks_total": gen["prefix_hit_blocks_total"],
+        "stream_digest": digest,
     }
+    if snap["kv_layout"] == "paged":
+        row["block_size"] = snap["block_size"]
+        row["blocks"] = snap["blocks"]
+    return row
 
 
 def run_point(eng, qps: float, duration: float, rng: np.random.RandomState,
@@ -243,6 +357,33 @@ def main():
                    help="[generate] KV-cache depth (prompt + generated)")
     p.add_argument("--gen-tokens", type=int, default=16,
                    help="[generate] tokens generated per request")
+    p.add_argument("--kv-layout", choices=("contiguous", "paged"),
+                   default="contiguous",
+                   help="[generate] KV-cache layout: per-slot max_len "
+                        "reservation vs block-table paging")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="[generate, paged] positions per KV block")
+    p.add_argument("--n-blocks", type=int, default=0,
+                   help="[generate, paged] pool size incl. the trash "
+                        "block (0 = match the contiguous footprint)")
+    p.add_argument("--prefix-reuse", action="store_true",
+                   help="[generate, paged] share full block-aligned "
+                        "prompt prefixes copy-on-write")
+    p.add_argument("--paged-kernel", action="store_true",
+                   help="[generate, paged] Pallas paged decode-attention "
+                        "kernel where supported")
+    p.add_argument("--prefix-tokens", type=int, default=0,
+                   help="[generate] fixed system-prompt tokens prepended "
+                        "to every request (the prefix-reuse traffic "
+                        "shape)")
+    p.add_argument("--cache-mb", type=float, default=0,
+                   help="[generate] fixed KV-cache byte budget; derives "
+                        "slots (contiguous) or pool+slots (paged) — the "
+                        "equal-bytes capacity comparison (0 = use "
+                        "--slots)")
+    p.add_argument("--json", default="",
+                   help="[generate] append one JSON line per operating "
+                        "point to this file")
     args = p.parse_args()
     if args.deadline_ms == 0:
         args.deadline_ms = None
@@ -282,6 +423,8 @@ def main():
 
 
 def run_generate(args):
+    import json
+
     eng = _build_gen_engine(args)
     rng = np.random.RandomState(0)
     points = [float(q) for q in str(args.qps).split(",")]
@@ -300,6 +443,10 @@ def run_generate(args):
               f"{row['tokens_per_sec']:>9.1f}{row['tps_user_p50']:>9.1f}"
               f"{(row['slot_fill'] or 0):>7.2f}"
               f"{row['overload_drops']:>10}{row['deadline_drops']:>10}")
+        print(json.dumps(row))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(row) + "\n")
         if not (np.isfinite(row["ttft_p50_ms"])
                 and np.isfinite(row["ttft_p99_ms"])):
             print("FAIL: empty TTFT report (no request completed)")

@@ -57,6 +57,65 @@ run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
 # lifecycle) run here, outside the tier-1 marker filter.
 timeout -k 10 300 python -m pytest tests/test_generate.py -q
 
+echo "== serving smoke: paged KV cache (same gate, block-table layout) =="
+# Identical qps/duration/gates as the contiguous generation smoke — the
+# paged engine must clear the same bar (docs/inference.md "Paged KV
+# cache").
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 5000 --kv-layout paged --block-size 16
+
+echo "== paged capacity: more concurrent streams at EQUAL cache bytes =="
+# The ROADMAP item-2 success metric: at a FIXED KV-cache byte budget
+# (--cache-mb sizes both layouts from the same budget), a burst of short
+# prompts must reach strictly higher peak concurrency on the paged
+# engine than on the contiguous one (whose slot count the worst-case
+# max_len reservation caps).
+rm -f /tmp/hvd_cap_contig.json /tmp/hvd_cap_paged.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 400 --duration 1 --deadline-ms 0 --cache-mb 0.5 --max-len 128 \
+  --kv-layout contiguous --json /tmp/hvd_cap_contig.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 400 --duration 1 --deadline-ms 0 --cache-mb 0.5 --max-len 128 \
+  --kv-layout paged --block-size 16 --json /tmp/hvd_cap_paged.json
+python - <<'PYEOF'
+import json
+c = json.loads(open("/tmp/hvd_cap_contig.json").read().splitlines()[-1])
+p = json.loads(open("/tmp/hvd_cap_paged.json").read().splitlines()[-1])
+assert c["cache_bytes"] == p["cache_bytes"], (c["cache_bytes"],
+                                              p["cache_bytes"])
+print(f"capacity @ {c['cache_bytes']} cache bytes: contiguous peak "
+      f"{c['peak_concurrent_streams']} (slots {c['max_slots']}), paged "
+      f"peak {p['peak_concurrent_streams']} (slots {p['max_slots']})")
+assert p["peak_concurrent_streams"] > c["peak_concurrent_streams"], \
+    "paged engine must sustain MORE concurrent streams at equal cache bytes"
+print("PAGED CAPACITY OK")
+PYEOF
+
+echo "== prefix reuse: nonzero hits, bit-identical streams vs no-reuse =="
+# Same seeded prompt mix (16-token shared system prefix) with reuse on
+# vs off: the reuse run must actually HIT the prefix cache, and the
+# completion-order-free digest of every greedy stream must be identical
+# — sharing saves memory, never changes a token.
+rm -f /tmp/hvd_px_on.json /tmp/hvd_px_off.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --kv-layout paged --block-size 16 \
+  --prefix-tokens 16 --prefix-reuse --json /tmp/hvd_px_on.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --kv-layout paged --block-size 16 \
+  --prefix-tokens 16 --json /tmp/hvd_px_off.json
+python - <<'PYEOF'
+import json
+on = json.loads(open("/tmp/hvd_px_on.json").read().splitlines()[-1])
+off = json.loads(open("/tmp/hvd_px_off.json").read().splitlines()[-1])
+assert on["completed"] == on["sent"] and off["completed"] == off["sent"]
+assert on["prefix_hits_total"] > 0, "prefix cache never hit"
+assert off["prefix_hits_total"] == 0
+assert on["stream_digest"] == off["stream_digest"], \
+    "prefix sharing changed a token stream"
+print(f"prefix reuse: {on['prefix_hits_total']} hits, digests identical")
+print("PREFIX REUSE OK")
+PYEOF
+
 echo "== striped host reduce (multi-core validation, gated on nproc) =="
 if [ "$(nproc)" -gt 1 ]; then
   # On a >=4-core host, striping must not LOSE to the serial reduce at

@@ -1,0 +1,200 @@
+"""Pallas paged decode-attention — the decode-path sibling of the
+training flash kernels in :mod:`.pallas_attention`.
+
+One query token per slot attends over that slot's KV *blocks*, gathered
+directly from the paged pool via the block table: the grid walks
+``(slot, head, logical_block)`` and a scalar-prefetched block table
+resolves each logical block to its physical pool index INSIDE the
+BlockSpec index map — the kernel never materializes the per-slot
+``[max_blocks·block_size, H, dh]`` contiguous view the pure-lax fallback
+gathers (at real configs that view is the whole cache re-laid-out per
+step; the kernel streams exactly the blocks each slot owns). Online
+softmax (running max/sum, fp32 accumulation) across the block axis,
+per-slot length masking, blocks past the slot's position skipped
+entirely.
+
+Gating discipline mirrors ``pallas_attention``'s ``_fused_bwd_fits``
+pattern: the engine flips the kernel on only when
+:func:`paged_attention_supported` says the shapes tile on the running
+backend (``d_head`` a lane multiple on real TPUs; anything goes in
+interpreter mode), and the pure-lax gather fallback — the
+bit-identity-bearing reference — keeps the whole stack green everywhere
+else. :func:`paged_attention_reference` IS that fallback's math;
+``tests/test_paged_kv.py`` pins kernel-vs-reference allclose on CPU
+(interpret mode executes the same kernel program the TPU would run).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def paged_attention_supported(d_head: int, block_size: int,
+                              interpret: Optional[bool] = None) -> bool:
+    """Whether the kernel path runs these shapes: interpreter mode (CPU
+    tests) takes anything; a real TPU needs lane-aligned ``d_head`` and
+    a sublane-aligned block so Mosaic can tile the K/V blocks."""
+    if not _HAS_PALLAS:
+        return False
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret:
+        return True
+    return d_head % 128 == 0 and block_size % 8 == 0
+
+
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, bs: int, scale: float):
+    """Grid (slot, head, logical_block): one [1, d] query row against one
+    [bs, d] K/V block (resolved physical by the index maps). Softmax
+    state (acc/m/l) persists in scratch across the block axis; blocks
+    entirely past the slot's position — and every block of an inactive
+    (position < 0) slot — skip all compute, and the normalized output is
+    written at the last block step (zeros for a fully-masked row, via
+    the safe divide)."""
+    s = pl.program_id(0)
+    b = pl.program_id(2)
+    n_b = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[s]
+
+    @pl.when((pos >= 0) & (b * bs <= pos))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale            # [1, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)           # [bs, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [1, bs]
+        kpos = b * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        sc = jnp.where(kpos <= pos, sc, -1e30)
+        m_prev = m_ref[0, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(sc))
+        p = jnp.exp(sc - m_new)                             # [1, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[0, 0] = l_ref[0, 0] * alpha + jnp.sum(p)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [1, d]
+        m_ref[0, 0] = m_new
+
+    @pl.when(b == n_b - 1)
+    def _finish():
+        l = l_ref[0, 0]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_call(q, k_pool, v_pool, block_tables, positions,
+                sm_scale: float, interpret: bool):
+    S, H, d = q.shape
+    bs = k_pool.shape[1]
+    nb = block_tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, H, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda s, h, b, tbl, pos: (s, h, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda s, h, b, tbl, pos: (tbl[s, b], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda s, h, b, tbl, pos: (tbl[s, b], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda s, h, b, tbl, pos: (s, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),        # acc
+            pltpu.SMEM((1, 1), jnp.float32),        # running max
+            pltpu.SMEM((1, 1), jnp.float32),        # running sum
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, bs=bs, scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, positions, q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, positions, *,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Decode attention straight from the paged pool.
+
+    Args:
+      q: [S, H, d] — one query token per slot.
+      k_pool, v_pool: [n_blocks, block_size, H, d] — ONE layer's view of
+        the pool (callers index ``cache["k"][layer]``).
+      block_tables: [S, max_blocks] int32 physical block per logical
+        block, trash-padded past each slot's allocation.
+      positions: [S] int32 — attend keys ``0..positions[s]`` inclusive
+        (the just-written token); ``< 0`` = inactive row (output zeros).
+      sm_scale: softmax scale (default ``1/sqrt(d)``).
+      interpret: force interpreter mode (defaults to True off-TPU).
+
+    Returns [S, H, d] in ``q.dtype``. Forward-only (decode never
+    differentiates); allclose-pinned against
+    :func:`paged_attention_reference`.
+    """
+    S, H, d = q.shape
+    if not _HAS_PALLAS:
+        raise RuntimeError(
+            "paged_decode_attention needs jax.experimental.pallas; use "
+            "the pure-lax fallback (kernel=False) on this build")
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not paged_attention_supported(d, k_pool.shape[1],
+                                     interpret=interpret):
+        raise ValueError(
+            f"paged_decode_attention needs d_head%128==0 and "
+            f"block_size%8==0 on TPU; got d_head={d}, "
+            f"block_size={k_pool.shape[1]} (use the lax gather fallback)")
+    return _paged_call(q, k_pool, v_pool,
+                       jnp.asarray(block_tables, jnp.int32),
+                       jnp.asarray(positions, jnp.int32),
+                       float(sm_scale), bool(interpret))
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, positions,
+                              sm_scale: Optional[float] = None):
+    """The pure-lax gather fallback's math, standalone: gather each
+    slot's blocks into the contiguous [S, M, H, d] view and run the
+    ``_cached_attention`` einsum (f32 scores, -1e30 mask, f32 softmax) —
+    the same function the contiguous cache path computes, which is the
+    whole bit-identity story. Inactive rows (positions < 0) return
+    zeros, matching the kernel."""
+    S, H, d = q.shape
+    nb = block_tables.shape[1]
+    bs = k_pool.shape[1]
+    if sm_scale is None:
+        sm_scale = float(d) ** -0.5
+    kg = k_pool[block_tables].reshape(S, nb * bs, H, d)
+    vg = v_pool[block_tables].reshape(S, nb * bs, H, d)
+    s = jnp.einsum("shd,smhd->shm", q.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * sm_scale
+    m = jnp.arange(nb * bs, dtype=jnp.int32)
+    s = jnp.where(m[None, None, :] <= positions[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shm,smhd->shd", p, vg.astype(jnp.float32))
+    out = jnp.where(positions[:, None, None] >= 0, out, 0.0)
+    return out.astype(q.dtype)

@@ -7,6 +7,14 @@ all as shard_map-native building blocks over `create_hybrid_mesh`.
 """
 
 from .checkpoint import restore_sharded, save_sharded  # noqa: F401
+from .kv_blocks import (  # noqa: F401
+    BlockManager,
+    blocks_for,
+    init_paged_kv_cache,
+    paged_decode_step,
+    paged_kv_cache_specs,
+    paged_prefill,
+)
 from .mesh import AXES, axis_size, create_hybrid_mesh  # noqa: F401
 from .moe import moe_ffn  # noqa: F401
 from .pipeline import gpipe, one_f_one_b  # noqa: F401

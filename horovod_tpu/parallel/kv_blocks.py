@@ -1,0 +1,399 @@
+"""Paged KV-cache: a block-table layout for the generation cache.
+
+The contiguous cache (``transformer.init_kv_cache``) reserves ``max_len``
+rows per slot, so concurrent-user capacity is bounded by the WORST-CASE
+sequence length even when typical requests are short — the fragmentation
+problem paged attention solves. Here the cache is a fixed pool of
+``n_blocks`` blocks of ``block_size`` positions each
+(``[L, n_blocks, block_size, H, dh]``); a slot owns a *list* of blocks
+(its block-table row), "cache full" becomes "block pool empty", and slot
+count decouples from ``max_len``: short requests hold only the blocks
+they actually fill.
+
+Two halves:
+
+* **Device side** — :func:`init_paged_kv_cache` /
+  :func:`paged_prefill` / :func:`paged_decode_step`: fixed-shape jitted
+  programs that scatter/gather K/V *through the block table* (a
+  ``[max_slots, max_blocks]`` int32 input, host-managed, passed per
+  call). The attention math is bit-for-bit the contiguous path's: prefill
+  runs the same self-contained ``flash_attention`` (logits never read the
+  cache), and the decode gather reassembles each slot's
+  ``[max_blocks·block_size, H, dh]`` view before the SAME
+  ``_cached_attention`` einsum — so when the padded depths line up
+  (``max_len % block_size == 0``) a generation stream is **bit-identical**
+  across contiguous and paged layouts (pinned in
+  ``tests/test_paged_kv.py``). A Pallas kernel that gathers blocks
+  directly (no materialized per-slot view) sits behind ``kernel=True``
+  (:mod:`horovod_tpu.ops.pallas_paged_attention`).
+
+* **Host side** — :class:`BlockManager`: free-list allocation,
+  per-block refcounts, and a prefix registry for copy-on-write sharing
+  of full block-aligned prompt prefixes. A common system prompt is
+  written once and *shared* by every stream whose prompt starts with it
+  (refcounted); divergence is naturally copy-on-write because only FULL
+  prompt-covered blocks are ever shared — a writer's first divergent
+  position lands in the next (freshly allocated, private) block, and
+  prefill writes aimed at shared blocks are redirected to the reserved
+  trash block so a sharer can never perturb the registered bytes.
+
+Physical block 0 is the **trash block**: never allocated, the target of
+every redirected or inactive-slot write, and the padding entry of every
+block-table row. Garbage landing there is masked out of every attention
+by the per-slot length masking (exactly the contiguous cache's
+rows-beyond-length contract).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .transformer import (TransformerConfig, _cached_attention,
+                          _check_dense, _gen_weights, _prompt_forward,
+                          _step_forward)
+
+#: Physical block 0 — reserved, never allocated; see module docstring.
+TRASH_BLOCK = 0
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache positions."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, n_blocks: int,
+                        block_size: int, max_slots: int,
+                        dtype: Any = None) -> Dict:
+    """Fresh paged K/V pool: ``{"k", "v":
+    [n_layers, n_blocks, block_size, n_heads, d_head], "lengths":
+    [max_slots] int32}``.
+
+    Block tables are NOT part of the device cache — they change at every
+    admission and are host-managed (:class:`BlockManager`), passed into
+    :func:`paged_prefill` / :func:`paged_decode_step` as int32 inputs.
+    ``n_blocks`` includes the reserved trash block, so ``n_blocks - 1``
+    blocks are usable; memory is ``2 · n_layers · n_blocks · block_size ·
+    d_model`` elements regardless of ``max_slots``.
+    """
+    _check_dense(cfg, "init_paged_kv_cache")
+    if n_blocks < 2:
+        raise ValueError(
+            f"n_blocks must be >= 2 (block 0 is the reserved trash "
+            f"block), got {n_blocks}")
+    if block_size < 1 or (block_size & (block_size - 1)):
+        raise ValueError(
+            f"block_size must be a power of two (prefill buckets are "
+            f"powers of two and chunk the prompt by block), got "
+            f"{block_size}")
+    d_head = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_heads, d_head)
+    kv_dtype = cfg.dtype if dtype is None else dtype
+    return {"k": jnp.zeros(shape, kv_dtype),
+            "v": jnp.zeros(shape, kv_dtype),
+            "lengths": jnp.zeros((max_slots,), jnp.int32)}
+
+
+def paged_kv_cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    """PartitionSpec tree matching :func:`init_paged_kv_cache`: the head
+    axis shards over ``tp`` (mirroring ``param_specs``' column-parallel
+    wqkv, exactly as the contiguous ``kv_cache_specs``); blocks and
+    positions stay replicated."""
+    tp = "tp" if "tp" in set(mesh.axis_names) else None
+    kv = P(None, None, None, tp, None)
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def paged_prefill(params, tokens, cache: Dict, slot, write_row,
+                  cfg: TransformerConfig, length=None) -> Tuple[Dict, Any]:
+    """Full-prompt forward scattering every position's K/V through
+    ``write_row`` into the block pool.
+
+    Args:
+      tokens: [T] int32 prompt at a compiled bucket width (power of two).
+      slot: int32 scalar — which ``lengths`` row this stream owns.
+      write_row: [max_blocks] int32 — physical block for each logical
+        block of the sequence. Entries for SHARED prefix blocks (and for
+        bucket padding beyond the slot's allocation) point at
+        :data:`TRASH_BLOCK`, so a prefill can never write into a block
+        another stream reads.
+      length: true prompt length (defaults to ``T``).
+
+    Returns ``(cache', logits [T, vocab] f32)``. The attention is the
+    same self-contained ``flash_attention`` as the contiguous
+    ``prefill`` — logits read nothing from the pool, so they are
+    bit-identical to the contiguous layout's for the same prompt and
+    bucket (the cross-layout contract ``tests/test_paged_kv.py`` pins).
+    """
+    _check_dense(cfg, "paged_prefill")
+    params = _gen_weights(params)
+    T = tokens.shape[0]
+    bs = cache["k"].shape[2]
+    max_blocks = write_row.shape[0]
+    if T > max_blocks * bs:
+        raise ValueError(
+            f"prompt bucket {T} exceeds the table depth "
+            f"{max_blocks} blocks × {bs}")
+    # Block-aligned chunks of the bucket; the last may be partial (the
+    # top bucket is max_len itself, which need not align). Chunk sizes
+    # are static, so the scatter stays one fixed-shape program.
+    chunks = [(j * bs, bs) for j in range(T // bs)]
+    if T % bs:
+        chunks.append((T - T % bs, T % bs))
+    length = jnp.asarray(T if length is None else length, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    k_pool, v_pool = cache["k"], cache["v"]
+    zero = jnp.zeros((), jnp.int32)     # x64 mode: indices must agree
+
+    def store(li, k, v):
+        nonlocal k_pool, v_pool
+        li32 = jnp.asarray(li, jnp.int32)
+        for j, (start, rows) in enumerate(chunks):
+            idx = (li32, write_row[j], zero, zero, zero)
+            k_pool = lax.dynamic_update_slice(
+                k_pool, k[start:start + rows]
+                .astype(k_pool.dtype)[None, None], idx)
+            v_pool = lax.dynamic_update_slice(
+                v_pool, v[start:start + rows]
+                .astype(v_pool.dtype)[None, None], idx)
+
+    logits = _prompt_forward(params, tokens, cfg, store)
+    lengths = cache["lengths"].at[slot].set(length)
+    return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
+
+
+def paged_decode_step(params, last_tokens, cache: Dict, positions,
+                      block_tables, cfg: TransformerConfig, *,
+                      kernel: bool = False,
+                      interpret: Optional[bool] = None) -> Tuple[Dict, Any]:
+    """One autoregressive step for every slot, through the block table.
+
+    Args:
+      last_tokens: [S] int32 per-slot previous token (fixed shape — one
+        compiled program regardless of occupancy, as in ``decode_step``).
+      positions: [S] int32 write index; ``-1`` = inactive (its scratch
+        write is routed to whatever ``block_tables[s, 0]`` names — the
+        trash block for unoccupied slots — and its output row is garbage
+        to be ignored).
+      block_tables: [S, max_blocks] int32 — per-slot physical block list,
+        padded with :data:`TRASH_BLOCK` beyond the slot's allocation.
+      kernel: gather K/V inside the Pallas paged decode-attention kernel
+        (:func:`horovod_tpu.ops.pallas_paged_attention.
+        paged_decode_attention`) instead of the pure-lax gather +
+        ``_cached_attention`` fallback. The fallback is the reference:
+        its einsum sees the SAME ``[S, max_blocks·bs, H, dh]`` view the
+        contiguous cache holds natively, which is what makes paged and
+        contiguous streams bit-identical; the kernel is allclose-pinned
+        against it and gated off by default.
+
+    Returns ``(cache', logits [S, vocab] f32)`` with the same per-slot
+    row-independence contract as ``decode_step``.
+    """
+    _check_dense(cfg, "paged_decode_step")
+    params = _gen_weights(params)
+    S = last_tokens.shape[0]
+    d_head = cfg.d_model // cfg.n_heads
+    bs = cache["k"].shape[2]
+    max_blocks = block_tables.shape[1]
+    active = positions >= 0
+    pos = jnp.where(active, positions, 0).astype(jnp.int32)
+    rows = jnp.arange(S, dtype=jnp.int32)
+    phys = block_tables[rows, pos // bs]                # [S]
+    off = (pos % bs).astype(jnp.int32)
+    k_pool, v_pool = cache["k"], cache["v"]
+
+    def mix(li, q, k, v):
+        nonlocal k_pool, v_pool
+        k_pool = k_pool.at[li, phys, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[li, phys, off].set(v.astype(v_pool.dtype))
+        if kernel:
+            from ..ops.pallas_paged_attention import paged_decode_attention
+            return paged_decode_attention(
+                q, k_pool[li], v_pool[li], block_tables, pos,
+                interpret=interpret).astype(q.dtype)
+        kg = k_pool[li][block_tables].reshape(
+            S, max_blocks * bs, cfg.n_heads, d_head)
+        vg = v_pool[li][block_tables].reshape(
+            S, max_blocks * bs, cfg.n_heads, d_head)
+        return _cached_attention(q, kg, vg, pos)
+
+    logits = _step_forward(params, last_tokens, cfg, mix)
+    lengths = jnp.where(active, pos + 1, cache["lengths"]
+                        ).astype(jnp.int32)
+    return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
+
+
+# ---------------------------------------------------------------------------
+# Host-side block accounting: free list, refcounts, prefix registry.
+# ---------------------------------------------------------------------------
+
+
+class BlockManager:
+    """Host-side allocator for the paged pool: free list + per-block
+    refcounts + a prefix registry for copy-on-write prompt sharing.
+
+    Refcount semantics: an allocated block starts at 1 (its owning
+    stream); sharing a prefix block retains it (+1 per sharing stream);
+    registering a block in the prefix registry pins it with one more
+    ref, so a registered prefix survives its streams and serves future
+    hits. A block returns to the free list only at refcount 0;
+    :meth:`reclaim` evicts LRU registry entries (dropping their pin)
+    when the pool runs dry. All methods are thread-safe, but the
+    allocate/lookup/register flow assumes a single admitting thread (the
+    engine loop) — concurrent readers only see consistent gauges.
+
+    The registry keys are the raw token bytes of each block-aligned
+    prefix (``tokens[:j·block_size].tobytes()``), so a hit requires the
+    ENTIRE preceding prefix to match — exactly the condition under which
+    the cached K/V (a causal function of the preceding tokens) is valid
+    for the new stream.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (block 0 is reserved), got "
+                f"{n_blocks}")
+        self._n = int(n_blocks)
+        self._bs = int(block_size)
+        self._ref = np.zeros(self._n, np.int64)
+        self._ref[TRASH_BLOCK] = 1          # never allocated, never freed
+        self._free: List[int] = list(range(self._n - 1, 0, -1))
+        self._registry: "OrderedDict[bytes, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- gauges ------------------------------------------------------------
+
+    @property
+    def block_size(self) -> int:
+        return self._bs
+
+    @property
+    def usable(self) -> int:
+        """Allocatable blocks (the pool minus the trash block)."""
+        return self._n - 1
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        with self._lock:
+            return self.usable - len(self._free)
+
+    @property
+    def registry_size(self) -> int:
+        with self._lock:
+            return len(self._registry)
+
+    def gauges(self) -> Dict:
+        """The /stats block-pool block: plain ints, json-ready."""
+        with self._lock:
+            free = len(self._free)
+            return {"total": self.usable, "free": free,
+                    "used": self.usable - free,
+                    "registered_prefix_blocks": len(self._registry)}
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh blocks (refcount 1 each). Callers check
+        :attr:`free_count` (and :meth:`reclaim`) first; an empty pool
+        here is a bookkeeping bug, not backpressure."""
+        with self._lock:
+            if n > len(self._free):
+                raise RuntimeError(
+                    f"block pool exhausted: asked {n}, free "
+                    f"{len(self._free)} — admission must check "
+                    f"free_count/reclaim first")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def retain(self, blocks: List[int]) -> None:
+        """One more stream reference on each of ``blocks`` (prefix hit)."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise RuntimeError(
+                        f"retain of unallocated block {b}")
+                self._ref[b] += 1
+
+    def release(self, blocks: List[int]) -> None:
+        """Drop one reference per block; blocks at refcount 0 return to
+        the free list. The trash block is silently skipped (table rows
+        are padded with it)."""
+        with self._lock:
+            for b in blocks:
+                if b == TRASH_BLOCK:
+                    continue
+                self._ref[b] -= 1
+                if self._ref[b] < 0:
+                    raise RuntimeError(f"double free of block {b}")
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+    # -- prefix registry ---------------------------------------------------
+
+    def _key(self, tokens: np.ndarray, j: int) -> bytes:
+        return np.ascontiguousarray(
+            tokens[:(j + 1) * self._bs], dtype=np.int32).tobytes()
+
+    def lookup_prefix(self, tokens: np.ndarray) -> List[int]:
+        """Longest chain of registered full blocks matching the prompt's
+        block-aligned prefix; touches hits MRU so reclaim evicts cold
+        prefixes first."""
+        with self._lock:
+            hits: List[int] = []
+            for j in range(len(tokens) // self._bs):
+                key = self._key(tokens, j)
+                blk = self._registry.get(key)
+                if blk is None:
+                    break
+                self._registry.move_to_end(key)
+                hits.append(blk)
+            return hits
+
+    def register_prefix(self, tokens: np.ndarray, blocks: List[int],
+                        n_full: int) -> None:
+        """Pin the prompt's first ``n_full`` blocks in the registry
+        (idempotent for already-registered chains)."""
+        with self._lock:
+            for j in range(n_full):
+                key = self._key(tokens, j)
+                if key in self._registry:
+                    self._registry.move_to_end(key)
+                    continue
+                self._registry[key] = blocks[j]
+                self._ref[blocks[j]] += 1
+
+    def reclaim(self, need_free: int) -> bool:
+        """Evict registered prefixes, LRU-first, until ``need_free``
+        blocks are free. Only entries whose block's SOLE reference is
+        the registry pin are evicted — popping a stream-referenced entry
+        frees nothing and would just wipe the cache for future
+        admissions (a transiently starved request must not disable
+        prefix reuse for everyone else). Returns whether the target was
+        met; entries skipped here free up for a later sweep when their
+        streams end."""
+        with self._lock:
+            if len(self._free) >= need_free:
+                return True
+            for key in list(self._registry):        # LRU → MRU order
+                if len(self._free) >= need_free:
+                    break
+                blk = self._registry[key]
+                if self._ref[blk] == 1:
+                    del self._registry[key]
+                    self._ref[blk] = 0
+                    self._free.append(blk)
+            return len(self._free) >= need_free

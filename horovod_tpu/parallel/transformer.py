@@ -305,9 +305,10 @@ def chunked_nll(x, embed, labels, cfg: TransformerConfig):
 # ---------------------------------------------------------------------------
 # Autoregressive generation: the prefill/decode pair over a slot-indexed KV
 # cache (the model layer under horovod_tpu.serve.generate's continuous-
-# batching engine). Pure functions of (params, cache) — the cache is a plain
-# pytree so it jits, donates, and shards like any other state. Unlike the
-# training forward these run OUTSIDE shard_map: params placed with
+# batching engine; the paged block-table variants live in kv_blocks.py and
+# share these helpers). Pure functions of (params, cache) — the cache is a
+# plain pytree so it jits, donates, and shards like any other state. Unlike
+# the training forward these run OUTSIDE shard_map: params placed with
 # ``param_specs`` NamedShardings partition the matmuls under GSPMD, and
 # ``kv_cache_specs`` shards the cache's head axis over ``tp`` to match the
 # column-parallel wqkv layout (a tp column-slice holds whole heads).
@@ -343,7 +344,13 @@ def init_kv_cache(cfg: TransformerConfig, max_slots: int, max_len: int,
     of slot ``s`` hold real K/V. Rows beyond a slot's length are garbage by
     contract (padded prefill writes land there) and are masked out of every
     attention; a slot's row is rewritten by the next ``prefill`` into it,
-    so slots recycle without clearing."""
+    so slots recycle without clearing.
+
+    This is the CONTIGUOUS layout: every slot reserves ``max_len`` rows
+    up front, so concurrent capacity is bounded by worst-case sequence
+    length. :mod:`.kv_blocks` holds the paged sibling (fixed-size block
+    pool + per-slot block tables, bit-identical streams) for workloads
+    where typical requests run far short of ``max_len``."""
     _check_dense(cfg, "init_kv_cache")
     d_head = cfg.d_model // cfg.n_heads
     shape = (cfg.n_layers, max_slots, max_len, cfg.n_heads, d_head)
@@ -361,6 +368,65 @@ def kv_cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict:
     tp = "tp" if "tp" in _axes(mesh) else None
     kv = P(None, None, None, tp, None)
     return {"k": kv, "v": kv, "lengths": P()}
+
+
+def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv):
+    """Shared prompt-phase forward for the contiguous and paged prefills
+    (``params`` already through :func:`_gen_weights`): per layer the
+    computed K/V is handed to ``store_kv(li, k, v)`` (k/v
+    ``[T, n_heads, d_head]``) — the ONLY layout-specific piece — and the
+    attention is the same self-contained ``flash_attention`` either way,
+    so both layouts' prefill logits are bitwise identical by
+    construction (the cross-layout contract tests/test_paged_kv.py
+    pins). Returns logits ``[T, vocab]`` f32."""
+    from ..ops.pallas_attention import flash_attention
+    T = tokens.shape[0]
+    d_head = cfg.d_model // cfg.n_heads
+    x = params["embed"][tokens][None].astype(cfg.dtype)     # [1, T, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln1"])
+        qkv = h @ layer["wqkv"].astype(cfg.dtype)
+        qkv = qkv.reshape(1, T, cfg.n_heads, 3, d_head)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        store_kv(li, k[0], v[0])
+        attn = flash_attention(q, k, v, causal=True,
+                               backend=cfg.attn_backend).astype(cfg.dtype)
+        x = x + attn.reshape(1, T, cfg.n_heads * d_head) \
+            @ layer["wo"].astype(cfg.dtype)
+        h2 = _rms_norm(x, layer["ln2"])
+        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
+        x = x + up @ layer["w2"].astype(cfg.dtype)
+    x = _rms_norm(x, params["lnf"])
+    return jnp.matmul(x.astype(cfg.unembed_dtype),
+                      params["embed"].T.astype(cfg.unembed_dtype),
+                      preferred_element_type=jnp.float32)[0]
+
+
+def _step_forward(params, last_tokens, cfg: TransformerConfig, mix):
+    """Shared decode-step forward (``params`` already through
+    :func:`_gen_weights`): ``mix(li, q, k, v)`` does the layout-specific
+    cache write + attention read (q/k/v ``[S, n_heads, d_head]`` → attn
+    of the same shape); everything else — the layer math both
+    bit-identity contracts ride on — exists exactly once. Returns
+    logits ``[S, vocab]`` f32."""
+    S = last_tokens.shape[0]
+    d_head = cfg.d_model // cfg.n_heads
+    x = params["embed"][last_tokens].astype(cfg.dtype)      # [S, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln1"])
+        qkv = (h @ layer["wqkv"].astype(cfg.dtype)
+               ).reshape(S, cfg.n_heads, 3, d_head)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        attn = mix(li, q, k, v)
+        x = x + attn.reshape(S, cfg.n_heads * d_head) \
+            @ layer["wo"].astype(cfg.dtype)
+        h2 = _rms_norm(x, layer["ln2"])
+        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
+        x = x + up @ layer["w2"].astype(cfg.dtype)
+    x = _rms_norm(x, params["lnf"])
+    return jnp.matmul(x.astype(cfg.unembed_dtype),
+                      params["embed"].T.astype(cfg.unembed_dtype),
+                      preferred_element_type=jnp.float32)
 
 
 def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
@@ -383,7 +449,6 @@ def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
     independent of what other slots hold (the continuous-batching
     invariance contract).
     """
-    from ..ops.pallas_attention import flash_attention
     _check_dense(cfg, "prefill")
     params = _gen_weights(params)
     T = tokens.shape[0]
@@ -393,31 +458,18 @@ def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
             f"{cache['k'].shape[2]}")
     length = jnp.asarray(T if length is None else length, jnp.int32)
     slot = jnp.asarray(slot, jnp.int32)
-    d_head = cfg.d_model // cfg.n_heads
     k_cache, v_cache = cache["k"], cache["v"]
-    x = params["embed"][tokens][None].astype(cfg.dtype)     # [1, T, D]
-    for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["ln1"])
-        qkv = h @ layer["wqkv"].astype(cfg.dtype)
-        qkv = qkv.reshape(1, T, cfg.n_heads, 3, d_head)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        zero = jnp.zeros((), jnp.int32)   # x64 mode: indices must agree
+    zero = jnp.zeros((), jnp.int32)       # x64 mode: indices must agree
+
+    def store(li, k, v):
+        nonlocal k_cache, v_cache
         idx = (jnp.asarray(li, jnp.int32), slot, zero, zero, zero)
         k_cache = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype)[None], idx)
+            k_cache, k.astype(k_cache.dtype)[None, None], idx)
         v_cache = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype)[None], idx)
-        attn = flash_attention(q, k, v, causal=True,
-                               backend=cfg.attn_backend).astype(cfg.dtype)
-        x = x + attn.reshape(1, T, cfg.n_heads * d_head) \
-            @ layer["wo"].astype(cfg.dtype)
-        h2 = _rms_norm(x, layer["ln2"])
-        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
-        x = x + up @ layer["w2"].astype(cfg.dtype)
-    x = _rms_norm(x, params["lnf"])
-    logits = jnp.matmul(x.astype(cfg.unembed_dtype),
-                        params["embed"].T.astype(cfg.unembed_dtype),
-                        preferred_element_type=jnp.float32)[0]
+            v_cache, v.astype(v_cache.dtype)[None, None], idx)
+
+    logits = _prompt_forward(params, tokens, cfg, store)
     lengths = cache["lengths"].at[slot].set(length)
     return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits
 
@@ -462,29 +514,18 @@ def decode_step(params, last_tokens, cache: Dict, positions,
     _check_dense(cfg, "decode_step")
     params = _gen_weights(params)
     S = last_tokens.shape[0]
-    d_head = cfg.d_model // cfg.n_heads
     active = positions >= 0
     pos = jnp.where(active, positions, 0).astype(jnp.int32)
     rows = jnp.arange(S, dtype=jnp.int32)
     k_cache, v_cache = cache["k"], cache["v"]
-    x = params["embed"][last_tokens].astype(cfg.dtype)      # [S, D]
-    for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["ln1"])
-        qkv = (h @ layer["wqkv"].astype(cfg.dtype)
-               ).reshape(S, cfg.n_heads, 3, d_head)
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+    def mix(li, q, k, v):
+        nonlocal k_cache, v_cache
         k_cache = k_cache.at[li, rows, pos].set(k.astype(k_cache.dtype))
         v_cache = v_cache.at[li, rows, pos].set(v.astype(v_cache.dtype))
-        attn = _cached_attention(q, k_cache[li], v_cache[li], pos)
-        x = x + attn.reshape(S, cfg.n_heads * d_head) \
-            @ layer["wo"].astype(cfg.dtype)
-        h2 = _rms_norm(x, layer["ln2"])
-        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
-        x = x + up @ layer["w2"].astype(cfg.dtype)
-    x = _rms_norm(x, params["lnf"])
-    logits = jnp.matmul(x.astype(cfg.unembed_dtype),
-                        params["embed"].T.astype(cfg.unembed_dtype),
-                        preferred_element_type=jnp.float32)
+        return _cached_attention(q, k_cache[li], v_cache[li], pos)
+
+    logits = _step_forward(params, last_tokens, cfg, mix)
     lengths = jnp.where(active, pos + 1, cache["lengths"]
                         ).astype(jnp.int32)
     return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits

@@ -17,7 +17,10 @@ guide.
 
 For the transformer LM, :class:`~.generate.GenerationEngine` adds
 continuous-batching KV-cache generation (requests join/leave the decode
-batch every step) with streaming token delivery:
+batch every step) with streaming token delivery — with contiguous
+per-slot KV reservations or a paged block pool with copy-on-write
+prefix sharing (``GenerationConfig(kv_layout="paged", ...)``; see
+``docs/inference.md`` "Paged KV cache"):
 
     params = serve.restore_for_inference(ckpt_dir, dtype="int8")["params"]
     gen = serve.GenerationEngine(params, cfg,
@@ -48,6 +51,14 @@ from .server import HttpServer  # noqa: F401
 from ..parallel.checkpoint import (  # noqa: F401
     INFERENCE_DTYPES,
     restore_for_inference,
+)
+from ..parallel.kv_blocks import (  # noqa: F401
+    BlockManager,
+    blocks_for,
+    init_paged_kv_cache,
+    paged_decode_step,
+    paged_kv_cache_specs,
+    paged_prefill,
 )
 from ..parallel.transformer import (  # noqa: F401
     decode_step,

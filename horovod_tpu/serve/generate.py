@@ -16,6 +16,17 @@ slot-managed KV memory instead:
   cache read-write depends only on that row), so a request's token stream
   is **bit-identical** whether it runs alone or joins a busy batch — the
   invariance contract tests/test_generate.py pins.
+* **KV memory is a layout knob** (``GenerationConfig.kv_layout``):
+  ``"contiguous"`` reserves ``max_len`` rows per slot (capacity bounded
+  by worst-case length), ``"paged"`` carves the same bytes into a
+  fixed-size block pool with per-slot block tables
+  (:mod:`horovod_tpu.parallel.kv_blocks`) — a stream holds only the
+  blocks it fills, "cache full" becomes "block pool empty", and
+  admission tracks free BLOCKS next to free slots. ``prefix_reuse=True``
+  additionally shares full block-aligned prompt prefixes copy-on-write
+  across streams (a system prompt's K/V lives once). Streams stay
+  bit-identical across all three configurations
+  (tests/test_paged_kv.py).
 * **Compile cache** (the PR-2 pattern): one AOT-compiled decode
   executable for the engine's (max_slots, max_len), plus one prefill
   executable per power-of-two prompt bucket; :meth:`GenerationEngine.
@@ -42,6 +53,7 @@ import dataclasses
 import queue as std_queue
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,6 +62,9 @@ import numpy as np
 
 from ..exceptions import (DeadlineExceededError, ServerClosedError,
                           ServerOverloadedError)
+from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
+                                  init_paged_kv_cache, paged_decode_step,
+                                  paged_prefill)
 from ..parallel.transformer import (TransformerConfig, decode_step,
                                     init_kv_cache, prefill)
 from .batcher import RequestQueue, bucket_for
@@ -90,9 +105,29 @@ class SamplingParams:
 @dataclasses.dataclass(frozen=True)
 class GenerationConfig:
     """Engine knobs. ``max_slots`` is the decode batch width (the number
-    of concurrently generating requests) and ``max_len`` the KV-cache
-    depth (prompt + generated tokens per request) — together they size
-    the cache: ``2 · n_layers · max_slots · max_len · d_model`` elements.
+    of concurrently generating requests) and ``max_len`` the per-request
+    cache depth cap (prompt + generated tokens). How much HBM that costs
+    depends on ``kv_layout``:
+
+    * ``"contiguous"`` reserves ``max_len`` rows per slot up front —
+      ``2 · n_layers · max_slots · max_len · d_model`` cache elements,
+      capacity bounded by the WORST-case sequence length.
+    * ``"paged"`` allocates ``2 · n_layers · n_blocks · block_size ·
+      d_model`` elements once and hands slots blocks as they fill them;
+      a short stream holds only ``ceil(len/block_size)`` blocks, so the
+      same bytes admit more concurrent short streams, and admission is
+      bounded by free blocks (``blocks_exhausted``) as well as free
+      slots (``slots_full``).
+
+    ``block_size`` (paged) is the positions-per-block knob — a
+    TPU-lane-friendly power of two; 16 default. ``n_blocks`` (paged)
+    sizes the pool INCLUDING the reserved trash block; ``None`` matches
+    the contiguous footprint (``max_slots · ceil(max_len/block_size) +
+    1``). ``prefix_reuse`` (paged) shares full block-aligned prompt
+    prefixes copy-on-write across streams. ``paged_kernel`` gathers
+    decode attention through the Pallas paged kernel where supported
+    (``ops.pallas_paged_attention``); off = the pure-lax gather
+    fallback, the bit-identity reference, everywhere-green path.
     The rest mirrors :class:`~.engine.ServeConfig`'s backpressure
     contract."""
 
@@ -102,6 +137,11 @@ class GenerationConfig:
     default_deadline_ms: Optional[float] = None
     default_max_new_tokens: int = 64
     eos_id: Optional[int] = None
+    kv_layout: str = "contiguous"
+    block_size: int = 16
+    n_blocks: Optional[int] = None
+    prefix_reuse: bool = False
+    paged_kernel: bool = False
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -110,6 +150,38 @@ class GenerationConfig:
             raise ValueError(f"max_len must be >= 1, got {self.max_len}")
         if self.default_max_new_tokens < 1:
             raise ValueError("default_max_new_tokens must be >= 1")
+        if self.kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got "
+                f"{self.kv_layout!r}")
+        if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
+            raise ValueError(
+                f"block_size must be a power of two, got {self.block_size}")
+        if self.kv_layout != "paged":
+            for knob in ("prefix_reuse", "paged_kernel"):
+                if getattr(self, knob):
+                    raise ValueError(
+                        f"{knob}=True requires kv_layout='paged'")
+            if self.n_blocks is not None:
+                raise ValueError(
+                    "n_blocks applies to kv_layout='paged' only")
+        elif self.n_blocks is not None and self.n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (block 0 is the reserved trash "
+                f"block), got {self.n_blocks}")
+
+    @property
+    def blocks_per_slot(self) -> int:
+        """Blocks a full-depth (``max_len``) sequence occupies."""
+        return blocks_for(self.max_len, self.block_size)
+
+    @property
+    def resolved_n_blocks(self) -> int:
+        """``n_blocks`` with the default applied (contiguous-footprint
+        pool + the trash block)."""
+        if self.n_blocks is not None:
+            return self.n_blocks
+        return self.max_slots * self.blocks_per_slot + 1
 
 
 class GenerationHandle:
@@ -240,10 +312,30 @@ class GenerationEngine(ReadinessMixin):
         self._cfg = config
         self._queue = RequestQueue(config.max_queue)
         self._metrics = ServeMetrics()
-        self._cache = init_kv_cache(model_cfg, config.max_slots,
-                                    config.max_len)
-        self._buckets = prefill_buckets(config.max_len)
+        self._paged = config.kv_layout == "paged"
         s = config.max_slots
+        if self._paged:
+            from ..ops.pallas_paged_attention import paged_attention_supported
+            self._n_blocks = config.resolved_n_blocks
+            self._cache = init_paged_kv_cache(
+                model_cfg, self._n_blocks, config.block_size, s)
+            self._blocks = BlockManager(self._n_blocks, config.block_size)
+            max_blocks = config.blocks_per_slot
+            self._tables = np.full((s, max_blocks), TRASH_BLOCK, np.int32)
+            self._slot_blocks: List[List[int]] = [[] for _ in range(s)]
+            d_head = model_cfg.d_model // model_cfg.n_heads
+            self._use_kernel = bool(
+                config.paged_kernel
+                and paged_attention_supported(d_head, config.block_size))
+        else:
+            self._cache = init_kv_cache(model_cfg, s, config.max_len)
+            self._blocks = None
+        self._buckets = prefill_buckets(config.max_len)
+        # Requests popped from the admission queue but not yet in a slot
+        # (the paged layout can be slot-free but block-starved; FIFO is
+        # preserved — a head request short on blocks holds the line).
+        self._held: deque = deque()
+        self._peak_active = 0
         self._slots: List[Optional[_GenRequest]] = [None] * s
         self._positions = np.full((s,), -1, np.int32)
         self._last = np.zeros((s,), np.int32)
@@ -284,11 +376,33 @@ class GenerationEngine(ReadinessMixin):
                 p_sds = self._sds(self._params)
                 c_sds = self._sds(self._cache)
                 i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+                nb = self._cfg.blocks_per_slot
                 if key == "decode":
-                    def _decode(p, toks, c, pos):
-                        return decode_step(p, toks, c, pos, cfg)
-                    exe = (jax.jit(_decode)
-                           .lower(p_sds, i32(s), c_sds, i32(s)).compile())
+                    if self._paged:
+                        kern = self._use_kernel
+
+                        def _decode(p, toks, c, pos, tbl):
+                            return paged_decode_step(p, toks, c, pos, tbl,
+                                                     cfg, kernel=kern)
+                        exe = (jax.jit(_decode)
+                               .lower(p_sds, i32(s), c_sds, i32(s),
+                                      i32(s, nb)).compile())
+                    else:
+                        def _decode(p, toks, c, pos):
+                            return decode_step(p, toks, c, pos, cfg)
+                        exe = (jax.jit(_decode)
+                               .lower(p_sds, i32(s), c_sds, i32(s))
+                               .compile())
+                elif self._paged:
+                    t = key[1]
+
+                    def _paged_pf(p, toks, c, slot, length, wrow):
+                        c2, logits = paged_prefill(p, toks, c, slot, wrow,
+                                                   cfg, length=length)
+                        return c2, logits[length - 1]
+                    exe = (jax.jit(_paged_pf)
+                           .lower(p_sds, i32(t), c_sds, i32(), i32(),
+                                  i32(nb)).compile())
                 else:
                     t = key[1]
 
@@ -313,14 +427,25 @@ class GenerationEngine(ReadinessMixin):
         outputs are discarded, so it stays pristine). Returns the keys
         warmed."""
         s = self._cfg.max_slots
-        out = self._compile("decode")(
-            self._params, np.zeros((s,), np.int32), self._cache,
-            np.full((s,), -1, np.int32))
+        nb = self._cfg.blocks_per_slot
+        if self._paged:
+            # All-trash tables/rows: warmup scratch lands in the reserved
+            # block, the pool stays pristine.
+            out = self._compile("decode")(
+                self._params, np.zeros((s,), np.int32), self._cache,
+                np.full((s,), -1, np.int32),
+                np.full((s, nb), TRASH_BLOCK, np.int32))
+        else:
+            out = self._compile("decode")(
+                self._params, np.zeros((s,), np.int32), self._cache,
+                np.full((s,), -1, np.int32))
         jax.block_until_ready(out)
         for t in self._buckets:
-            out = self._compile(("prefill", t))(
-                self._params, np.zeros((t,), np.int32), self._cache,
-                np.asarray(0, np.int32), np.asarray(1, np.int32))
+            args = [self._params, np.zeros((t,), np.int32), self._cache,
+                    np.asarray(0, np.int32), np.asarray(1, np.int32)]
+            if self._paged:
+                args.append(np.full((nb,), TRASH_BLOCK, np.int32))
+            out = self._compile(("prefill", t))(*args)
             jax.block_until_ready(out)
         self._warmed = True
         return ("decode",) + tuple(self._buckets)
@@ -361,6 +486,15 @@ class GenerationEngine(ReadinessMixin):
         # Token t+1's K/V lands at position L+t; the last sampled token
         # needs no cache write, so room caps new tokens at max_len-L+1.
         max_new = min(max_new, self._cfg.max_len - toks.size + 1)
+        if self._paged:
+            need = self._blocks_needed(toks.size, max_new)
+            if need > self._blocks.usable:
+                raise ValueError(
+                    f"request needs {need} KV blocks (prompt "
+                    f"{toks.size} + up to {max_new} generated, "
+                    f"block_size={self._cfg.block_size}) but the pool "
+                    f"holds only {self._blocks.usable} usable blocks — "
+                    f"raise n_blocks or lower max_new_tokens")
         sampling = SamplingParams() if sampling is None else sampling
         eos = self._cfg.eos_id if eos_id is _DEFAULT else eos_id
         if deadline_ms is None:
@@ -377,10 +511,42 @@ class GenerationEngine(ReadinessMixin):
         try:
             depth = self._queue.put(req)    # raises Closed / Overloaded
         except ServerOverloadedError:
-            self._metrics.on_overload()
-            raise
+            reason, detail = self._overload_reason(toks.size, max_new)
+            self._metrics.on_overload(reason)
+            raise ServerOverloadedError(
+                f"request queue full ({self._cfg.max_queue}); "
+                f"{reason}: {detail}") from None
         self._metrics.on_submit(depth)
         return handle
+
+    def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        """KV blocks a request reserves at admission: every position it
+        can write (the last sampled token needs no write)."""
+        total = min(prompt_len + max_new - 1, self._cfg.max_len)
+        return blocks_for(total, self._cfg.block_size)
+
+    def _overload_reason(self, prompt_len: int,
+                         max_new: int) -> Tuple[str, str]:
+        """Name the scarce resource behind a full admission queue:
+        ``blocks_exhausted`` when slots are free but the paged pool
+        cannot cover this request, else ``slots_full``. Racy reads —
+        this labels an error message and a counter, it gates nothing."""
+        s = self._cfg.max_slots
+        if self._paged:
+            free_slots = sum(r is None for r in self._slots)
+            need = self._blocks_needed(prompt_len, max_new)
+            free_blocks = self._blocks.free_count
+            if free_slots > 0 and free_blocks < need:
+                return ("blocks_exhausted",
+                        f"{free_blocks}/{self._blocks.usable} KV blocks "
+                        f"free, next request needs {need} — raise "
+                        f"n_blocks or lower max_new_tokens")
+            return ("slots_full",
+                    f"all {s} decode slots busy and the queue is full — "
+                    f"raise max_slots/max_queue or shed load")
+        return ("slots_full",
+                f"all {s} decode slots busy and the queue is full — "
+                f"raise max_slots/max_queue or shed load")
 
     def generate(self, tokens: Sequence[int],
                  timeout: Optional[float] = None, **kw) -> Dict:
@@ -395,7 +561,16 @@ class GenerationEngine(ReadinessMixin):
         snap["max_slots"] = self._cfg.max_slots
         snap["max_len"] = self._cfg.max_len
         snap["active_slots"] = sum(r is not None for r in self._slots)
+        snap["peak_active_slots"] = self._peak_active
         snap["prefill_buckets"] = list(self._buckets)
+        snap["kv_layout"] = self._cfg.kv_layout
+        if self._paged:
+            snap["block_size"] = self._cfg.block_size
+            snap["blocks"] = self._blocks.gauges()
+            hits = snap["generation"]["prefix_hits_total"]
+            misses = snap["generation"]["prefix_misses_total"]
+            snap["prefix_hit_rate"] = (hits / (hits + misses)
+                                       if hits + misses else None)
         with self._stats_lock:
             snap["compiled"] = sorted(map(str, self._compiled_ids))
         snap["max_queue"] = self._cfg.max_queue
@@ -440,24 +615,46 @@ class GenerationEngine(ReadinessMixin):
         while True:
             try:
                 if self._abort:
-                    self._fail_active(ServerClosedError(
-                        "server shut down before completion"))
+                    err = ServerClosedError(
+                        "server shut down before completion")
+                    for req in self._held:
+                        req.handle._fail(err)
+                    self._held.clear()
+                    self._fail_active(err)
                     return
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 n_active = self._cfg.max_slots - len(free)
-                if free and (n_active == 0 or len(self._queue)):
-                    # Blocks ONLY when fully idle (no active streams and
-                    # an empty queue); with streams in flight it drains
-                    # whatever is queued without waiting.
-                    batch = self._queue.take_batch(len(free), 0.0)
-                    if not batch and n_active == 0:
+                idle = n_active == 0 and not self._held
+                want = len(free) - len(self._held)
+                if want > 0 and (idle or len(self._queue)):
+                    # Blocks ONLY when fully idle (no active streams,
+                    # nothing held, an empty queue); with streams in
+                    # flight it drains whatever is queued without waiting.
+                    batch = self._queue.take_batch(want, 0.0)
+                    if not batch and idle:
                         return      # closed and drained, nothing in flight
-                    for req in batch:
-                        slot = free.pop(0)
-                        if not self._admit(req, slot):
-                            free.insert(0, slot)
+                    self._held.extend(batch)
+                while self._held and free:
+                    outcome = self._admit(self._held[0], free[0])
+                    if outcome == "starved":
+                        # Head-of-line request can't get KV blocks yet;
+                        # decode steps below will free some. FIFO holds —
+                        # nobody jumps the starved head.
+                        break
+                    self._held.popleft()
+                    if outcome == "ok":
+                        free.pop(0)
                 if any(r is not None for r in self._slots):
                     self._decode_once()
+                elif self._held:
+                    # Starved with nothing in flight: the submit-time
+                    # pool-size check makes this unreachable (every block
+                    # is free or reclaimable, and need <= usable). Fail
+                    # loudly rather than spin.
+                    req = self._held.popleft()
+                    req.handle._fail(ServerOverloadedError(
+                        "KV block pool cannot cover an admitted request "
+                        "with the engine idle — admission accounting bug"))
             except Exception as e:  # noqa: BLE001 — deliver, don't die
                 self._fail_active(e)
 
@@ -465,13 +662,44 @@ class GenerationEngine(ReadinessMixin):
         for i, req in enumerate(self._slots):
             if req is not None:
                 req.handle._fail(exc)
-                self._slots[i] = None
-                self._positions[i] = -1
+                self._release_slot(i)
 
-    def _admit(self, req: _GenRequest, slot: int) -> bool:
-        """Prefill ``req`` into ``slot`` and emit its first token; returns
-        True iff the slot is now occupied (a request that expires in the
-        queue, fails, or finishes on its first token never occupies)."""
+    def _release_slot(self, i: int) -> None:
+        """Vacate slot ``i``: paged layouts return its blocks to the pool
+        (refcount-aware — a shared prefix block frees only when its last
+        reader ends) and trash-out its table row."""
+        self._slots[i] = None
+        self._positions[i] = -1
+        if self._paged:
+            self._blocks.release(self._slot_blocks[i])
+            self._slot_blocks[i] = []
+            self._tables[i] = TRASH_BLOCK
+
+    def _paged_reserve(self, req: _GenRequest):
+        """Reserve the blocks ``req`` needs: prefix-registry hits are
+        retained (shared), the rest freshly allocated — or None when the
+        pool can't cover it yet. Re-resolves hits after every reclaim
+        sweep (an eviction can take chain entries the first lookup
+        matched)."""
+        n_total = self._blocks_needed(req.tokens.size, req.max_new)
+        while True:
+            hits = (self._blocks.lookup_prefix(req.tokens)
+                    if self._cfg.prefix_reuse else [])
+            hits = hits[:n_total]
+            need = n_total - len(hits)
+            if self._blocks.free_count >= need:
+                self._blocks.retain(hits)
+                fresh = self._blocks.alloc(need)
+                return hits, fresh, n_total
+            if not self._blocks.reclaim(need):
+                return None
+
+    def _admit(self, req: _GenRequest, slot: int) -> str:
+        """Prefill ``req`` into ``slot`` and emit its first token.
+        Returns ``"ok"`` (slot occupied), ``"done"`` (expired, failed, or
+        finished on its first token — slot stays free), or ``"starved"``
+        (paged only: not enough free KV blocks yet — the request stays
+        held and the slot stays free)."""
         now = time.monotonic()
         if req.expired(now):
             self._metrics.on_deadline_expired(
@@ -479,7 +707,14 @@ class GenerationEngine(ReadinessMixin):
             req.handle._fail(DeadlineExceededError(
                 f"deadline expired after "
                 f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
-            return False
+            return "done"
+        reservation = None
+        row: List[int] = []
+        read_row = None
+        if self._paged:
+            reservation = self._paged_reserve(req)
+            if reservation is None:
+                return "starved"
         req.t_admit = now
         try:
             length = int(req.tokens.size)
@@ -487,38 +722,79 @@ class GenerationEngine(ReadinessMixin):
             toks = np.zeros((bucket,), np.int32)
             toks[:length] = req.tokens
             exe = self._compile(("prefill", bucket))
-            cache, last_logits = exe(
-                self._params, toks, self._cache,
-                np.asarray(slot, np.int32), np.asarray(length, np.int32))
+            if self._paged:
+                hits, fresh, n_total = reservation
+                row = hits + fresh
+                nb = self._cfg.blocks_per_slot
+                read_row = np.full((nb,), TRASH_BLOCK, np.int32)
+                read_row[:n_total] = row
+                # Writes aimed at SHARED prefix blocks go to the trash
+                # block: the recomputed prefix K/V is already resident,
+                # and a sharer must never touch bytes other streams read.
+                write_row = read_row.copy()
+                write_row[:len(hits)] = TRASH_BLOCK
+                n_full = length // self._cfg.block_size
+                if self._cfg.prefix_reuse and n_full > 0:
+                    self._metrics.on_prefix(len(hits), n_full)
+                cache, last_logits = exe(
+                    self._params, toks, self._cache,
+                    np.asarray(slot, np.int32),
+                    np.asarray(length, np.int32), write_row)
+            else:
+                cache, last_logits = exe(
+                    self._params, toks, self._cache,
+                    np.asarray(slot, np.int32),
+                    np.asarray(length, np.int32))
             logits = np.asarray(last_logits)    # blocks
         except Exception as e:  # noqa: BLE001
+            if reservation is not None:
+                hits, fresh, _ = reservation
+                self._blocks.release(hits + fresh)
             req.handle._fail(e)
-            return False
+            return "done"
         self._cache = cache
+        if self._paged and self._cfg.prefix_reuse:
+            # Pin the prompt's full blocks for future admissions — the
+            # prefix now lives in the pool whether or not this stream
+            # survives its first token.
+            n_full = int(req.tokens.size) // self._cfg.block_size
+            if n_full > 0:
+                self._blocks.register_prefix(req.tokens, row, n_full)
         req.t_first = time.monotonic()
         self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3)
         tok = req.sample(logits)
         req.n_out = 1
         self._metrics.on_tokens()
         req.handle._emit(tok)
-        reason = self._finish_reason(req, tok, next_pos=length)
+        reason = self._finish_reason(req, tok, next_pos=int(req.tokens.size))
         if reason:
             self._finish(req, reason)
-            return False
+            if self._paged:
+                self._blocks.release(row)
+            return "done"
         self._slots[slot] = req
-        self._positions[slot] = length
+        self._positions[slot] = int(req.tokens.size)
         self._last[slot] = tok
-        return True
+        if self._paged:
+            self._slot_blocks[slot] = row
+            self._tables[slot] = read_row
+        return "ok"
 
     def _decode_once(self) -> None:
         t0 = time.monotonic()
-        cache, logits = self._compile("decode")(
-            self._params, self._last.copy(), self._cache,
-            self._positions.copy())
+        if self._paged:
+            cache, logits = self._compile("decode")(
+                self._params, self._last.copy(), self._cache,
+                self._positions.copy(), self._tables.copy())
+        else:
+            cache, logits = self._compile("decode")(
+                self._params, self._last.copy(), self._cache,
+                self._positions.copy())
         logits_np = np.asarray(logits)          # blocks
         self._cache = cache
         exec_ms = (time.monotonic() - t0) * 1e3
         active = [i for i, r in enumerate(self._slots) if r is not None]
+        self._peak_active = max(self._peak_active, len(active))
         self._metrics.on_batch(self._cfg.max_slots, len(active), exec_ms,
                                len(self._queue))
         for i in active:
@@ -533,8 +809,7 @@ class GenerationEngine(ReadinessMixin):
                                          next_pos=int(self._positions[i]))
             if reason:
                 self._finish(req, reason)
-                self._slots[i] = None
-                self._positions[i] = -1
+                self._release_slot(i)
 
     def _finish_reason(self, req: _GenRequest, tok: int,
                        next_pos: int) -> Optional[str]:

@@ -68,6 +68,13 @@ class ServeMetrics:
         self.requests_total = 0
         self.responses_total = 0
         self.rejected_overload = 0
+        # Overload rejections split by the resource that was actually
+        # scarce when the door closed: "slots_full" (decode width / the
+        # single-shot engine's throughput) vs "blocks_exhausted" (the
+        # paged engine's KV block pool) — an operator raising max_slots
+        # when the pool is the binding constraint fixes nothing.
+        self.rejected_slots_full = 0
+        self.rejected_blocks_exhausted = 0
         self.expired_deadline = 0
         self.cancelled_shutdown = 0
         self.batches_total = 0
@@ -83,6 +90,14 @@ class ServeMetrics:
         self.tokens_generated_total = 0
         self._ttft_ms = _Reservoir(seed=3)
         self._tps_user = _Reservoir(seed=4)
+        # Prefix-cache effectiveness (the paged engine's reuse plane):
+        # a lookup counts as a hit when at least one full block of the
+        # prompt was already resident; hit_blocks/lookup_blocks give the
+        # block-level rate (how much prefill HBM sharing actually saves).
+        self.prefix_hits_total = 0
+        self.prefix_misses_total = 0
+        self.prefix_hit_blocks_total = 0
+        self.prefix_lookup_blocks_total = 0
 
     # -- producers ---------------------------------------------------------
 
@@ -91,9 +106,16 @@ class ServeMetrics:
             self.requests_total += 1
             self.queue_depth = queue_depth
 
-    def on_overload(self) -> None:
+    def on_overload(self, reason: str = "slots_full") -> None:
+        """``reason`` is ``"slots_full"`` or ``"blocks_exhausted"`` —
+        the engine names the scarce resource; ``rejected_overload``
+        stays the total so existing dashboards keep reading."""
         with self._lock:
             self.rejected_overload += 1
+            if reason == "blocks_exhausted":
+                self.rejected_blocks_exhausted += 1
+            else:
+                self.rejected_slots_full += 1
 
     def on_deadline_expired(self, queue_ms: float) -> None:
         with self._lock:
@@ -132,6 +154,17 @@ class ServeMetrics:
         with self._lock:
             self.tokens_generated_total += n
 
+    def on_prefix(self, hit_blocks: int, prompt_blocks: int) -> None:
+        """One prefix-cache lookup at admission: ``hit_blocks`` of the
+        prompt's ``prompt_blocks`` full blocks were already resident."""
+        with self._lock:
+            if hit_blocks > 0:
+                self.prefix_hits_total += 1
+            else:
+                self.prefix_misses_total += 1
+            self.prefix_hit_blocks_total += hit_blocks
+            self.prefix_lookup_blocks_total += prompt_blocks
+
     def on_generation_end(self, n_tokens: int, seconds: float) -> None:
         """One finished request: records its tokens/sec-per-user (first
         token → last token — the per-stream decode rate, not aggregate
@@ -152,6 +185,8 @@ class ServeMetrics:
                 "requests_total": self.requests_total,
                 "responses_total": self.responses_total,
                 "rejected_overload": self.rejected_overload,
+                "rejected_slots_full": self.rejected_slots_full,
+                "rejected_blocks_exhausted": self.rejected_blocks_exhausted,
                 "expired_deadline": self.expired_deadline,
                 "cancelled_shutdown": self.cancelled_shutdown,
                 "batches_total": self.batches_total,
@@ -178,6 +213,11 @@ class ServeMetrics:
                 "generation": {
                     "generations_total": self.generations_total,
                     "tokens_generated_total": self.tokens_generated_total,
+                    "prefix_hits_total": self.prefix_hits_total,
+                    "prefix_misses_total": self.prefix_misses_total,
+                    "prefix_hit_blocks_total": self.prefix_hit_blocks_total,
+                    "prefix_lookup_blocks_total":
+                        self.prefix_lookup_blocks_total,
                     "ttft_p50": self._ttft_ms.quantile(0.50),
                     "ttft_p99": self._ttft_ms.quantile(0.99),
                     "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
