@@ -259,6 +259,141 @@ print(f"NaN smoke OK: skip-step bit-exact, all_reduce count {n_guard} "
       f"unchanged by guard")
 EOF
 
+echo "== telemetry leg: /metrics exposition on the generation engine (ISSUE 12) =="
+# curl the serving /metrics route during a generation smoke and pin the
+# NAMED series the fleet tooling keys on (docs/observability.md):
+# the TTFT histogram buckets and the paged KV block-pool gauges.
+run_cpu timeout -k 10 240 python - <<'EOF'
+import subprocess, urllib.request
+import jax, jax.numpy as jnp
+from horovod_tpu import serve
+from horovod_tpu.obs.registry import parse_exposition
+from horovod_tpu.parallel.transformer import TransformerConfig, init_params
+
+cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                        d_ff=32, dtype=jnp.float32,
+                        unembed_dtype=jnp.float32, attn_backend="xla")
+params = init_params(jax.random.PRNGKey(0), cfg)
+eng = serve.GenerationEngine(params, cfg, serve.GenerationConfig(
+    max_slots=2, max_len=16, default_max_new_tokens=4,
+    kv_layout="paged", block_size=4))
+eng.warmup()
+for _ in range(3):
+    eng.generate([3, 1, 4, 1, 5], timeout=60)
+with serve.HttpServer(generate=eng) as srv:
+    url = f"http://{srv.host}:{srv.port}/metrics"
+    try:
+        body = subprocess.run(["curl", "-sf", url], check=True,
+                              capture_output=True).stdout.decode()
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        body = urllib.request.urlopen(url).read().decode()
+parsed = parse_exposition(body)
+names = {k[0] for k in parsed}
+for want in ("hvd_generate_ttft_seconds_bucket", "hvd_kv_blocks_free",
+             "hvd_kv_blocks_total", "hvd_tokens_generated_total",
+             "hvd_requests_total", "hvd_uptime_seconds"):
+    assert want in names, f"missing series {want}: {sorted(names)}"
+assert parsed[("hvd_tokens_generated_total",
+               (("engine", "generate"),))] >= 3
+assert body.count("# TYPE hvd_generations_total counter") == 1
+eng.shutdown()
+print(f"GENERATION /metrics OK: {len(parsed)} series, valid exposition")
+EOF
+
+echo "== telemetry leg: scrape 2 live training ranks + tpurun --metrics-summary =="
+# A 2-rank env-world Trainer job with HVD_METRICS_PORT set: both rank
+# listeners (base+0, base+1) must serve exposition text WHILE the job
+# trains, and the one-shot fleet poller must aggregate them into one
+# "2/2 ranks up" line — the PR-9 supervisor's first real fleet view.
+rm -f /tmp/rank0_metrics.txt /tmp/rank1_metrics.txt /tmp/fleet_line.out
+TL_PORT=$(python -c 'import socket; s=socket.socket(); s.bind(("127.0.0.1",0)); print(s.getsockname()[1]); s.close()')
+HVD_METRICS_PORT=$TL_PORT HVD_METRICS_HOST=127.0.0.1 \
+HVD_STEP_SLEEP_MS=300 HVD_TOTAL_STEPS=60 \
+  timeout -k 10 180 \
+  python -m horovod_tpu.launcher -np 2 --cpu \
+  python tests/obs_worker.py > /tmp/telemetry_train.out 2>&1 &
+TL_PID=$!
+trap 'kill "$TL_PID" 2>/dev/null || true' EXIT
+# NB: scrape to files, grep the files — `curl | grep -q` under pipefail
+# flakes on grep's early-exit SIGPIPE back into curl.
+tl_ok=""
+for _ in $(seq 1 120); do
+  curl -sf "http://127.0.0.1:$TL_PORT/metrics" \
+    -o /tmp/rank0_metrics.txt 2>/dev/null || true
+  curl -sf "http://127.0.0.1:$((TL_PORT+1))/metrics" \
+    -o /tmp/rank1_metrics.txt 2>/dev/null || true
+  # Nonzero step counts: the counters REGISTER at Trainer construction,
+  # so a zero-valued match would race the first actual step (and the
+  # first exchange, which registers the collective counters).
+  if grep -Eq 'hvd_steps_total\{rank="0"\} [1-9]' /tmp/rank0_metrics.txt \
+       2>/dev/null \
+     && grep -Eq 'hvd_steps_total\{rank="1"\} [1-9]' \
+       /tmp/rank1_metrics.txt 2>/dev/null; then
+    tl_ok=1; break
+  fi
+  sleep 0.5
+done
+[ -n "$tl_ok" ] || {
+  echo "FAIL: training ranks never served /metrics" >&2
+  cat /tmp/telemetry_train.out >&2
+  exit 1
+}
+for series in hvd_step_seconds_bucket hvd_samples_total \
+              hvd_collective_submits_total hvd_world_size; do
+  grep -q "$series" /tmp/rank0_metrics.txt || {
+    echo "FAIL: rank 0 /metrics missing series $series" >&2
+    exit 1
+  }
+done
+python -m horovod_tpu.launcher -np 2 --metrics-summary \
+  --metrics-port "$TL_PORT" | tee /tmp/fleet_line.out
+grep -q "fleet: 2/2 ranks up" /tmp/fleet_line.out || {
+  echo "FAIL: --metrics-summary did not aggregate both ranks" >&2
+  exit 1
+}
+wait "$TL_PID" || {
+  echo "FAIL: telemetry training job exited nonzero" >&2
+  cat /tmp/telemetry_train.out >&2
+  exit 1
+}
+trap - EXIT
+echo "TRAINING /metrics + fleet summary OK"
+
+echo "== telemetry leg: rank kill leaves a flight-recorder post-mortem =="
+# rank=1:kill@step=3 SIGKILLs rank 1 mid-training. The drilled rank's
+# dump (written by the fault injector, standing in for the platform's
+# SIGTERM-before-SIGKILL notice) must name its final completed step;
+# the SURVIVOR's dump (triggered by the WorkerFailureError abort) must
+# name the dead rank — post-mortems from files, not stdout greps.
+FR_DIR=$(mktemp -d)
+set +e
+HVD_FAULT_SPEC=rank=1:kill@step=3 HVD_FLIGHTREC_DIR="$FR_DIR" \
+HVD_HEARTBEAT_TIMEOUT=10 HVD_TOTAL_STEPS=8 \
+  timeout -k 10 180 \
+  python -m horovod_tpu.launcher -np 2 --cpu \
+  python tests/obs_worker.py > /tmp/telemetry_kill.out 2>&1
+fr_rc=$?
+set -e
+if [ "$fr_rc" -eq 0 ] || [ "$fr_rc" -eq 124 ]; then
+  echo "FAIL: kill drill rc=$fr_rc (0 = fault never fired, 124 = hang)" >&2
+  cat /tmp/telemetry_kill.out >&2
+  exit 1
+fi
+python - "$FR_DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+dead = json.load(open(f"{d}/hvd_flightrec.rank1.json"))
+assert dead["last_step"] == 3, dead["last_step"]
+assert "kill" in dead["reason"], dead["reason"]
+assert any(e["kind"] == "step" and e["step"] == 3
+           for e in dead["events"]), dead["events"][-5:]
+survivor = json.load(open(f"{d}/hvd_flightrec.rank0.json"))
+assert "rank 1" in survivor["reason"], survivor["reason"]
+print(f"FLIGHT RECORDER OK: dead rank's last step "
+      f"{dead['last_step']}, survivor names the dead rank")
+EOF
+rm -rf "$FR_DIR"
+
 echo "== live-resize chaos leg: shrink 4 -> 2 in place (quiesce, recommit, re-shard — no restart) =="
 # ISSUE 9 acceptance: resize:shrink=2@step=3 must quiesce at a step
 # boundary, recommit through the two-phase elastic commit, re-shard in

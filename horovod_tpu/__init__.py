@@ -77,6 +77,7 @@ from . import elastic  # noqa: F401
 from . import hooks  # noqa: F401
 from .hooks import BroadcastGlobalVariablesHook  # noqa: F401
 from . import models  # noqa: F401
+from . import obs  # noqa: F401
 from . import serve  # noqa: F401
 from . import training  # noqa: F401
 from .trainer import (  # noqa: F401

@@ -368,7 +368,7 @@ class CoordClient:
             # World already aborted (a rank or the coordinator died):
             # fail fast with the original diagnosis instead of feeding a
             # dead coordinator and hanging in wait.
-            raise WorkerFailureError(err.value.decode())
+            raise WorkerFailureError(self._abort_record(err.value.decode()))
         if rc != 0:
             raise TransportError(err.value.decode())
         self._inflight.add(name)
@@ -404,7 +404,7 @@ class CoordClient:
             # did). The message names the dead party; the collective can
             # never complete — recovery is a world restart
             # (tpurun --restarts + horovod_tpu.elastic).
-            raise WorkerFailureError(err.value.decode())
+            raise WorkerFailureError(self._abort_record(err.value.decode()))
         if rc != 0:
             raise TransportError(err.value.decode())
 
@@ -457,6 +457,24 @@ class CoordClient:
     def aborted(self) -> bool:
         """Whether the world has aborted (a rank or the coordinator died)."""
         return bool(self._lib.hvdcoord_aborted())
+
+    def _abort_record(self, msg: str) -> str:
+        """Leave this rank's post-mortem the moment a world ABORT
+        surfaces: one ``abort`` flight-recorder event plus a dump of the
+        ring (``hvd_flightrec.rank{N}.json``, :mod:`horovod_tpu.obs.
+        flightrec`) — every SURVIVING rank of a dead world records the
+        diagnosis (the message names the dead party) and its own last
+        completed step, so an operator reads files, not scrollback.
+        Returns ``msg`` unchanged so the raise sites stay one-liners;
+        repeated aborts just overwrite the dump (last record wins)."""
+        try:
+            from ..obs import flightrec
+            flightrec.record("abort", rank=self.rank, error=msg)
+            flightrec.dump(reason=f"coordinator abort: {msg}",
+                           rank=self.rank)
+        except Exception:  # noqa: BLE001 — never mask the abort itself
+            pass
+        return msg
 
     def mute_heartbeats(self, mute: bool = True) -> None:
         """Fault hook: stop this rank's heartbeats while the process (and

@@ -49,8 +49,16 @@ from typing import Any, Callable, Optional
 from . import runtime
 from .exceptions import (CheckpointCorruptError, StalledError,
                          TransportError, WorkerFailureError)
+from .obs import flightrec as _flightrec
+from .obs.registry import registry as _metrics_registry
 
 RECOVERABLE = (WorkerFailureError, StalledError, TransportError)
+
+
+def _m(kind: str, name: str, help_: str):
+    """Lazy named metric on the process-default registry (commits and
+    restores are rare; a registry lookup per event is fine here)."""
+    return getattr(_metrics_registry(), kind)(name, help_)
 
 
 def _log(msg: str) -> None:
@@ -148,6 +156,9 @@ class ElasticState:
         the marker hangs off the writer's on-durable hook."""
         from .parallel import checkpoint as _ckpt
         step = self.step
+        _m("counter", "hvd_commits_total",
+           "Elastic two-phase commits started").inc()
+        _flightrec.record("commit", step=step)
         if self.writer is None:
             path = _ckpt.save_sharded(self._dir(), step, self.params,
                                       self.opt_state,
@@ -252,6 +263,10 @@ class ElasticState:
                 _ckpt.verify_checkpoint(path)
             except CheckpointCorruptError as e:
                 self.discarded_corrupt += 1
+                _m("counter", "hvd_discarded_corrupt_total",
+                   "Committed-but-corrupt checkpoints skipped by the "
+                   "verified fallback walk").inc()
+                _flightrec.record("discard_corrupt", step=s)
                 print(f"[elastic] committed step {s} failed integrity "
                       f"verification — discarding and walking back "
                       f"({e})", file=sys.stderr, flush=True)
@@ -328,6 +343,10 @@ class ElasticState:
         self.params, self.opt_state, self.step = _ckpt.restore_sharded(
             self._dir(), self.params, self.opt_state, step=step,
             verify=force_verify or step not in self._verified_steps)
+        _m("counter", "hvd_restores_total",
+           "Elastic restores completed (recovery, rollback, resume)"
+           ).inc()
+        _flightrec.record("restore", step=int(step))
 
 
 # ---------------------------------------------------------------------------
@@ -850,6 +869,9 @@ class ResizeCoordinator:
         _log(f"resize: quiesced at step {state.step}; recommitting and "
              f"canonicalizing before re-forming the world "
              f"({old_world} -> {target}, generation {gen})")
+        _flightrec.record("resize_quiesce", step=int(state.step),
+                          old_world=old_world, target=target,
+                          generation=gen)
         # Recommit at the quiesce step through the unchanged two-phase
         # commit (drains any async writer first): the verified-restore
         # anchor if anything below fails, and the resume point if a rank
@@ -904,6 +926,10 @@ class ResizeCoordinator:
             self._pending = None
             self._proposal = None
             self.resizes_completed += 1
+            _m("counter", "hvd_resizes_total",
+               "Live elastic resizes completed").inc()
+            _flightrec.record("resize_complete", step=int(state.step),
+                              world=target, generation=gen)
             _log(f"resize complete: re-sharded optimizer state in place "
                  f"onto world {target} (generation {gen}); resuming at "
                  f"step {state.step} without restart")
@@ -917,6 +943,8 @@ class ResizeCoordinator:
         except SystemExit:
             raise
         except Exception as e:  # noqa: BLE001 — fallback is the contract
+            _flightrec.record("resize_fallback", target=target,
+                              error=repr(e))
             _log(f"resize: in-place re-shard failed ({e!r}); falling back "
                  f"to full verified restore of the quiesce commit")
             if not runtime.is_initialized():
@@ -941,6 +969,8 @@ class ResizeCoordinator:
             self._pending = None
             self._proposal = None
             self.resizes_completed += 1
+            _m("counter", "hvd_resizes_total",
+               "Live elastic resizes completed").inc()
             _log(f"resize complete (via verified restore fallback): "
                  f"world {target}, resuming at step {state.step}")
             return rebuilt
@@ -1051,7 +1081,11 @@ def run_with_recovery(train_fn: Callable[[ElasticState], Any],
             f"[elastic] exiting for supervised restart (run under "
             f"tpurun --restarts N to resume from the last committed "
             f"step)\n")
+        _flightrec.record("world_failure", step=int(state.step),
+                          error=repr(e))
         # Crash-safe teardown (shutdown tolerates a dead coordinator) so
-        # the relaunched world starts from a clean slate.
-        runtime.shutdown()
+        # the relaunched world starts from a clean slate; error= dumps
+        # the flight recorder FIRST — this rank's post-mortem record,
+        # naming its last completed step (obs.flightrec).
+        runtime.shutdown(error=e)
         raise

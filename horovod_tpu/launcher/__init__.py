@@ -334,12 +334,39 @@ class _ResizeSupervisor:
         return []
 
 
+def _fleet_poller(world: int, metrics_port: Optional[int],
+                  interval: float, ranks=None):
+    """Build the ``--metrics-summary`` fleet poller when a metrics base
+    port is known (flag or inherited ``HVD_METRICS_PORT``); None
+    otherwise. ``ranks`` restricts the scrape to this node's rank block
+    on multi-host launches (remote ranks' listeners are not on this
+    loopback). Imported lazily — the launcher must not pull the obs
+    stack unless asked."""
+    base = metrics_port
+    if not base:
+        try:
+            base = int(os.environ.get("HVD_METRICS_PORT", "0") or 0)
+        except ValueError:
+            base = 0
+    if not base:
+        sys.stderr.write(
+            "tpurun: --metrics-summary needs a metrics base port "
+            "(--metrics-port or HVD_METRICS_PORT) — no fleet view\n")
+        return None
+    from ..obs.summary import FleetPoller
+    return FleetPoller("127.0.0.1", base, world, timeout=max(
+        0.2, min(2.0, interval / 2)), ranks=ranks)
+
+
 def _launch_once(np_: int, command: List[str], *,
                  coord_port: Optional[int], jax_distributed: bool,
                  cpu: bool, node_rank: int, nnodes: int,
                  coordinator: Optional[str], extra_env: Optional[dict],
                  restart_epoch: int,
-                 max_np: Optional[int] = None) -> "tuple[int, bool, int]":
+                 max_np: Optional[int] = None,
+                 metrics_summary: bool = False,
+                 metrics_port: Optional[int] = None,
+                 metrics_interval: float = 10.0) -> "tuple[int, bool, int]":
     """One supervised world launch: spawn, watch ALL ranks, fail fast.
 
     The seed's wait loop blocked on workers in spawn order: rank 3 dying
@@ -394,11 +421,18 @@ def _launch_once(np_: int, command: List[str], *,
     old_int = signal.signal(signal.SIGINT, _forward)
     old_usr1 = signal.signal(signal.SIGUSR1, _resize_signal)
     old_usr2 = signal.signal(signal.SIGUSR2, _resize_signal)
+    fleet_stop = None   # set below; the finally must see it even when
+    fleet_world = {"w": world}   # the spawn loop raises first
 
     def _rank_env(rank: int, cur_world: int, addr: str,
                   resize_generation: int = 0) -> dict:
         env = dict(os.environ)
         env.update(extra_env or {})
+        if metrics_port:
+            # Each rank's obs listener binds metrics_port + rank
+            # (horovod_tpu.obs.http); the flag is the launcher-side
+            # spelling of HVD_METRICS_PORT.
+            env["HVD_METRICS_PORT"] = str(metrics_port)
         env["HVD_RANK"] = str(rank)
         env["HVD_SIZE"] = str(cur_world)
         env["HVD_LOCAL_RANK"] = str(
@@ -435,6 +469,31 @@ def _launch_once(np_: int, command: List[str], *,
         resize = _ResizeSupervisor(
             coord_addr=coord_addr, world=world, cap=max_np,
             enabled=(nnodes == 1 and not jax_distributed))
+        # --metrics-summary runs on its OWN daemon thread: a hung rank
+        # listener (up to ranks × 2 s of blocking scrapes) must never
+        # stall the 0.05 s fail-fast poll that tears dead worlds down.
+        if metrics_summary:
+            local_ranks = (None if nnodes == 1 else
+                           range(node_rank * np_, (node_rank + 1) * np_))
+            fleet = _fleet_poller(world, metrics_port, metrics_interval,
+                                  ranks=local_ranks)
+            if fleet is not None:
+                import threading
+                fleet_stop = threading.Event()
+
+                def _fleet_loop():
+                    fleet_stop.wait(min(metrics_interval, 2.0))
+                    while not fleet_stop.is_set():
+                        fleet.set_world(fleet_world["w"])
+                        try:
+                            sys.stderr.write(
+                                f"tpurun: {fleet.line()}\n")
+                        except Exception:  # noqa: BLE001 — telemetry
+                            pass           # must never kill supervision
+                        fleet_stop.wait(metrics_interval)
+
+                threading.Thread(target=_fleet_loop, daemon=True,
+                                 name="tpurun-fleet").start()
         rc = 0
         while True:
             running = 0
@@ -489,6 +548,7 @@ def _launch_once(np_: int, command: List[str], *,
                 if p is not None:
                     _reap([p])
             world = resize.world
+            fleet_world["w"] = world
             time.sleep(0.05)
         if rc and running:
             # Let the world's own abort cascade surface the diagnosis
@@ -522,6 +582,8 @@ def _launch_once(np_: int, command: List[str], *,
         return rc, interrupted["sig"] is not None, \
             (world if nnodes == 1 else np_)
     finally:
+        if fleet_stop is not None:
+            fleet_stop.set()
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGUSR1, old_usr1)
@@ -535,7 +597,10 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
            coordinator: Optional[str] = None,
            extra_env: Optional[dict] = None,
            restarts: int = 0,
-           max_np: Optional[int] = None) -> int:
+           max_np: Optional[int] = None,
+           metrics_summary: bool = False,
+           metrics_port: Optional[int] = None,
+           metrics_interval: float = 10.0) -> int:
     """Spawn ``np_`` local ranks of ``command`` with the world env wired up.
 
     Multi-host: run tpurun on every host with the same ``--coordinator
@@ -578,7 +643,9 @@ def launch(np_: int, command: List[str], *, coord_port: Optional[int] = None,
             coord_port=coord_port if epoch == 0 else None,
             jax_distributed=jax_distributed, cpu=cpu, node_rank=node_rank,
             nnodes=nnodes, coordinator=coordinator, extra_env=extra_env,
-            restart_epoch=epoch, max_np=max_np)
+            restart_epoch=epoch, max_np=max_np,
+            metrics_summary=metrics_summary, metrics_port=metrics_port,
+            metrics_interval=metrics_interval)
         if interrupted:
             # Operator interruption (Ctrl-C / scheduler SIGTERM) is a
             # command to STOP, not a failure to retry — never relaunch.
@@ -630,9 +697,39 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(default: the initial -np). A direct admin "
                              "RPC (coord.client.request_resize) is not "
                              "capped — the operator named an exact size")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="base port of the per-rank /metrics "
+                             "listeners: rank r serves on port+r "
+                             "(exports HVD_METRICS_PORT to every rank; "
+                             "see docs/observability.md)")
+    parser.add_argument("--metrics-summary", action="store_true",
+                        help="scrape every rank's /metrics and print one "
+                             "aggregated fleet line. With a command: "
+                             "every --metrics-interval seconds while "
+                             "supervising. WITHOUT a command: one shot "
+                             "against an already-running job's ranks, "
+                             "then exit (needs -np + --metrics-port or "
+                             "HVD_METRICS_PORT)")
+    parser.add_argument("--metrics-interval", type=float, default=10.0,
+                        help="seconds between fleet lines under "
+                             "--metrics-summary (default 10)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the command to run, e.g. python train.py")
     args = parser.parse_args(argv)
+    if args.metrics_interval <= 0:
+        parser.error("--metrics-interval must be > 0")
+    if args.metrics_summary and not args.command:
+        # One-shot fleet view of a job launched elsewhere: scrape the N
+        # rank listeners once, print the line, exit 0 when any rank
+        # answered (an all-dead fleet is worth a nonzero exit — the
+        # operator asked "how is it doing" and the answer is "it isn't").
+        fleet = _fleet_poller(args.np, args.metrics_port,
+                              args.metrics_interval)
+        if fleet is None:
+            return 2
+        line = fleet.line()
+        print(f"tpurun: {line}", flush=True)
+        return 0 if not line.startswith("fleet: 0/") else 1
     if not args.command:
         parser.error("no command given")
     if args.nnodes > 1 and not args.coordinator:
@@ -645,7 +742,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   jax_distributed=args.jax_distributed, cpu=args.cpu,
                   node_rank=args.node_rank, nnodes=args.nnodes,
                   coordinator=args.coordinator, restarts=args.restarts,
-                  max_np=args.max_np)
+                  max_np=args.max_np,
+                  metrics_summary=args.metrics_summary,
+                  metrics_port=args.metrics_port,
+                  metrics_interval=args.metrics_interval)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+"""One telemetry plane for training and serving.
+
+Three pieces, one surface (``docs/observability.md`` holds the metric
+name inventory and scrape recipes):
+
+* :mod:`.registry` — lock-light counters/gauges/histograms with
+  Prometheus text exposition; the process-default registry is the
+  training plane's shared namespace.
+* :mod:`.http` — the per-rank ``GET /metrics`` listener
+  (``HVD_METRICS_PORT``, port + rank, 0 disables), started by
+  ``runtime.init()``. The serving plane exposes the same format on the
+  existing :class:`~horovod_tpu.serve.server.HttpServer` (``/metrics``
+  next to ``/stats``).
+* :mod:`.flightrec` — the crash-safe flight recorder: a bounded ring of
+  recent structured events dumped to ``hvd_flightrec.rank{N}.json``
+  when a rank dies badly, so a post-mortem names the final step without
+  grepping stdout.
+
+:mod:`.summary` aggregates the per-rank endpoints into the
+``tpurun --metrics-summary`` fleet line.
+"""
+
+from . import flightrec  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
+from .http import MetricsListener  # noqa: F401
+from .registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    parse_exposition,
+    registry,
+    render,
+)
+from .summary import FleetPoller, scrape  # noqa: F401

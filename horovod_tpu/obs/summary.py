@@ -1,0 +1,112 @@
+"""Fleet view: scrape every rank's ``/metrics`` and print ONE line.
+
+The PR-9 supervisor (``tpurun``) watches exit codes and resize status —
+it has no idea whether the job it babysits is training at speed,
+crawling, or skipping every step on NaNs. ``tpurun --metrics-summary``
+turns the per-rank listeners (:mod:`.http`) into that missing fleet
+view: scrape ``base_port + r`` for every rank, aggregate, one line.
+
+Aggregation rules (per series NAME, labels ignored — each rank's
+registry carries its own ``rank`` const label):
+
+* counters (``*_total``) sum across ranks — fleet throughput;
+* ``hvd_global_step`` reports min/max — a spread is a straggler;
+* everything is cumulative, so the poller keeps the previous sample and
+  prints rates (steps/s, samples/s, tokens/s) from the delta.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from .registry import parse_exposition
+
+
+def scrape(host: str, port: int, timeout: float = 2.0) -> Optional[Dict]:
+    """One rank's parsed ``/metrics`` (series-name → summed value), or
+    None when unreachable (a dead/not-yet-up rank is a datum, not an
+    error)."""
+    url = f"http://{host}:{port}/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+    out: Dict[str, float] = {}
+    for (name, _labels), v in parse_exposition(text).items():
+        out[name] = out.get(name, 0.0) + v
+    return out
+
+
+class FleetPoller:
+    """Stateful aggregator: each :meth:`line` call scrapes all ranks and
+    renders one operator-facing summary, with rates computed against the
+    previous poll."""
+
+    def __init__(self, host: str, base_port: int, world: int,
+                 timeout: float = 2.0, ranks=None):
+        """``ranks``: the rank indices to scrape (default all of
+        ``range(world)``). A multi-host launcher passes its LOCAL rank
+        block — remote ranks' listeners live on other machines, and
+        polling them on this host's loopback would report a healthy job
+        as permanently degraded."""
+        self.host = host
+        self.base_port = int(base_port)
+        self.world = int(world)
+        self.timeout = timeout
+        self._ranks = None if ranks is None else list(ranks)
+        self._prev: Optional[Dict[str, float]] = None
+        self._prev_t: Optional[float] = None
+
+    def set_world(self, world: int) -> None:
+        """Live resize moved the world size; later polls scrape the new
+        rank set (explicit ``ranks`` clamp to it)."""
+        self.world = int(world)
+
+    def ranks(self) -> List[int]:
+        if self._ranks is None:
+            return list(range(self.world))
+        return [r for r in self._ranks if r < self.world]
+
+    def sample(self) -> List[Optional[Dict]]:
+        return [scrape(self.host, self.base_port + r, self.timeout)
+                for r in self.ranks()]
+
+    def line(self) -> str:
+        samples = self.sample()
+        now = time.monotonic()
+        up = [s for s in samples if s is not None]
+        totals: Dict[str, float] = {}
+        for s in up:
+            for k, v in s.items():
+                totals[k] = totals.get(k, 0.0) + v
+        steps = [s.get("hvd_global_step") for s in up
+                 if s.get("hvd_global_step") is not None]
+        n_polled = len(samples)
+        scope = ("" if self._ranks is None or n_polled == self.world
+                 else " (this node)")
+        parts = [f"fleet: {len(up)}/{n_polled} ranks up{scope}"]
+        if steps:
+            lo, hi = int(min(steps)), int(max(steps))
+            parts.append(f"step {lo}" if lo == hi
+                         else f"step {lo}..{hi} (straggler spread "
+                              f"{hi - lo})")
+        if self._prev is not None and self._prev_t is not None:
+            dt = max(1e-9, now - self._prev_t)
+            for key, label in (("hvd_steps_total", "steps/s"),
+                               ("hvd_samples_total", "samples/s"),
+                               ("hvd_tokens_generated_total", "tokens/s")):
+                if key in totals:
+                    rate = (totals[key] - self._prev.get(key, 0.0)) / dt
+                    parts.append(f"{label} {max(0.0, rate):.1f}")
+        for key, label in (("hvd_bad_steps_total", "bad_steps"),
+                           ("hvd_commits_total", "commits"),
+                           ("hvd_restores_total", "restores"),
+                           ("hvd_resizes_total", "resizes")):
+            if key in totals:
+                parts.append(f"{label} {int(totals[key])}")
+        self._prev, self._prev_t = totals, now
+        return " | ".join(parts)

@@ -81,6 +81,10 @@ _world: Optional[World] = None
 # object identity, which can be reused after GC) to key caches of compiled
 # collective executables across shutdown/re-init cycles.
 _generation = 0
+# Per-rank metrics HTTP listener (HVD_METRICS_PORT; horovod_tpu.obs.http).
+# Module-level, not a World field: it must survive the frozen dataclass
+# and be restartable across the shutdown/re-init cycle a live resize runs.
+_metrics_listener = None
 
 
 def init(devices: Optional[Sequence[jax.Device]] = None,
@@ -170,7 +174,38 @@ def init(devices: Optional[Sequence[jax.Device]] = None,
             timeline=timeline,
             env_world=env_world,
         )
+        _start_observability(_world)
         return _world
+
+
+def _start_observability(w: World) -> None:
+    """Bring the telemetry plane up for this world: the per-rank
+    ``/metrics`` listener (HVD_METRICS_PORT; no-op when unset), the
+    world-shape gauges every scrape carries, the fatal-signal
+    flight-recorder dump, and the init event itself. Failures here warn
+    — telemetry must never kill a training job."""
+    global _metrics_listener
+    from .obs import flightrec, http as _obs_http
+    from .obs.registry import registry as _registry_fn
+    try:
+        flightrec.install_signal_dump()
+        flightrec.record("init", rank=w.process_index, world=w.size,
+                         env_world=w.env_world)
+        reg = _registry_fn()
+        reg.gauge("hvd_world_size",
+                  "Number of ranks (chips) in the world").set(w.size)
+        reg.gauge("hvd_rank", "This process's rank").set(w.process_index)
+        if _metrics_listener is None:
+            _metrics_listener = _obs_http.start_from_env(w.process_index)
+        if w.timeline is not None:
+            # A killed rank's chrome trace should survive alongside its
+            # flight record (utils/timeline.py registers its own atexit
+            # close; this covers the fatal-signal path).
+            flightrec.add_crash_hook(w.timeline.flush)
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        import warnings
+        warnings.warn(f"observability startup failed: {e!r} — the world "
+                      f"runs without a metrics listener")
 
 
 def _maybe_init_jax_distributed() -> None:
@@ -225,14 +260,36 @@ def _infer_local_rank(devs: Sequence[jax.Device], process_index: int) -> int:
     return 0
 
 
-def shutdown() -> None:
+def shutdown(error: Optional[BaseException] = None) -> None:
     """Tear the world down (parity: ``HorovodGlobalState`` destructor →
     SHUTDOWN broadcast → ``MPI_Finalize``; ``mpi_ops.cc:207-215, 1437-1447,
-    1511``). Safe to call multiple times."""
-    global _world
+    1511``). Safe to call multiple times.
+
+    ``error=`` marks this teardown as a FAILURE path: the flight
+    recorder's ring is dumped to ``hvd_flightrec.rank{N}.json`` before
+    anything else is torn down, so the rank leaves a post-mortem naming
+    its last completed step (:mod:`horovod_tpu.obs.flightrec`).
+    :func:`horovod_tpu.elastic.run_with_recovery` routes every
+    recoverable world failure through here.
+    """
+    global _world, _metrics_listener
+    if error is not None:
+        from .obs import flightrec
+        flightrec.record("shutdown_error", error=repr(error))
+        flightrec.dump(reason=f"runtime.shutdown(error={error!r})")
+        flightrec.run_crash_hooks()
     with _lock:
         if _world is None:
             return
+        if _metrics_listener is not None:
+            try:
+                _metrics_listener.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+            _metrics_listener = None
+        if _world.timeline is not None:
+            from .obs import flightrec
+            flightrec.remove_crash_hook(_world.timeline.flush)
         if _world.coord is not None:
             try:
                 _world.coord.shutdown()

@@ -241,6 +241,21 @@ class Engine(ReadinessMixin):
         snap["batch_timeout_ms"] = self._cfg.batch_timeout_ms
         return snap
 
+    def prom_collect(self):
+        """This engine's ``(meta, samples)`` in Prometheus terms —
+        everything :meth:`stats` knows plus the latency histograms,
+        labeled ``engine="predict"`` (see
+        :func:`~horovod_tpu.serve.metrics.collect_stats`)."""
+        from .metrics import collect_stats
+        return collect_stats(self.stats(), self._metrics.registry,
+                             engine="predict")
+
+    def prom_metrics(self) -> str:
+        """Prometheus text exposition of :meth:`prom_collect` (the
+        ``/metrics`` body when this engine serves alone)."""
+        from ..obs.registry import render
+        return render(*self.prom_collect())
+
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the engine. ``drain=True`` serves everything already
         queued first; ``drain=False`` fails pending futures with

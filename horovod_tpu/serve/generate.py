@@ -576,6 +576,22 @@ class GenerationEngine(ReadinessMixin):
         snap["max_queue"] = self._cfg.max_queue
         return snap
 
+    def prom_collect(self):
+        """This engine's ``(meta, samples)`` in Prometheus terms —
+        everything :meth:`stats` knows (TTFT, tokens/sec/user,
+        block-pool gauges, prefix hit rate, rejection splits) plus the
+        histograms, labeled ``engine="generate"`` (see
+        :func:`~horovod_tpu.serve.metrics.collect_stats`)."""
+        from .metrics import collect_stats
+        return collect_stats(self.stats(), self._metrics.registry,
+                             engine="generate")
+
+    def prom_metrics(self) -> str:
+        """Prometheus text exposition of :meth:`prom_collect` (the
+        ``/metrics`` body when this engine serves alone)."""
+        from ..obs.registry import render
+        return render(*self.prom_collect())
+
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the engine. ``drain=True`` finishes every stream already
         admitted (queued AND mid-generation) first; ``drain=False`` fails

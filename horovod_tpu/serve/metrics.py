@@ -19,31 +19,45 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.registry import Meta, MetricsRegistry, Sample
 
 
 class _Reservoir:
-    """Fixed-size uniform reservoir of float samples (Vitter's algorithm R)."""
+    """Fixed-size uniform reservoir of float samples (Vitter's algorithm R).
+
+    Self-locking: every historical caller mutates under the owning
+    :class:`ServeMetrics` lock, but the reservoir is also handed out as
+    a building block (tests, benches) — and a ``quantile()`` racing an
+    ``add()``'s list replacement would read a torn sample set. The lock
+    is uncontended in the single-owner case, so it costs nothing where
+    the outer lock already serializes.
+    """
 
     def __init__(self, capacity: int = 4096, seed: int = 0):
         self._cap = int(capacity)
         self._seen = 0
         self._vals: List[float] = []
         self._rng = random.Random(seed)
+        self._rlock = threading.Lock()
 
     def add(self, value: float) -> None:
-        self._seen += 1
-        if len(self._vals) < self._cap:
-            self._vals.append(value)
-            return
-        j = self._rng.randrange(self._seen)
-        if j < self._cap:
-            self._vals[j] = value
+        with self._rlock:
+            self._seen += 1
+            if len(self._vals) < self._cap:
+                self._vals.append(value)
+                return
+            j = self._rng.randrange(self._seen)
+            if j < self._cap:
+                self._vals[j] = value
 
     def quantile(self, q: float) -> Optional[float]:
-        if not self._vals:
+        with self._rlock:
+            vals = sorted(self._vals)
+        if not vals:
             return None
-        vals = sorted(self._vals)
         # Nearest-rank on the sorted reservoir — monotone in q and exact
         # for small sample counts (the property tests rely on).
         idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
@@ -51,7 +65,8 @@ class _Reservoir:
 
     @property
     def count(self) -> int:
-        return self._seen
+        with self._rlock:
+            return self._seen
 
 
 class ServeMetrics:
@@ -63,8 +78,34 @@ class ServeMetrics:
     none — an operator acts on them.
     """
 
+    # Bucket bounds for the per-user decode rate (tokens/sec): not a
+    # latency, so the latency default would waste every bucket under 1.
+    TPS_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 200, 500, 1000)
+
     def __init__(self):
         self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        # Prometheus-scrapable histograms, PRIVATE per engine (two
+        # engines in one process must not collide on one registry).
+        # The reservoirs above keep feeding the JSON /stats percentiles;
+        # histograms are what a real scraper needs — cumulative bucket
+        # counts survive counter resets and aggregate across replicas,
+        # which reservoir percentiles never can.
+        self.registry = MetricsRegistry()
+        self._h_request = self.registry.histogram(
+            "hvd_request_seconds", "End-to-end request latency")
+        self._h_queue = self.registry.histogram(
+            "hvd_queue_seconds", "Time from submit to execution start")
+        self._h_execute = self.registry.histogram(
+            "hvd_execute_seconds", "Device batch execution time")
+        self._h_ttft = self.registry.histogram(
+            "hvd_generate_ttft_seconds",
+            "Time to first token (submit to the prefill's sampled "
+            "token)")
+        self._h_tps = self.registry.histogram(
+            "hvd_generate_tokens_per_sec_user",
+            "Per-stream decode rate (first token to last)",
+            buckets=self.TPS_BUCKETS)
         self.requests_total = 0
         self.responses_total = 0
         self.rejected_overload = 0
@@ -134,12 +175,15 @@ class ServeMetrics:
             self.batch_live_rows_total += live_rows
             self.queue_depth = queue_depth
             self._execute_ms.add(execute_ms)
+        self._h_execute.observe(execute_ms / 1e3)
 
     def on_response(self, request_ms: float, queue_ms: float) -> None:
         with self._lock:
             self.responses_total += 1
             self._request_ms.add(request_ms)
             self._queue_ms.add(queue_ms)
+        self._h_request.observe(request_ms / 1e3)
+        self._h_queue.observe(queue_ms / 1e3)
 
     # -- generation plane ----------------------------------------------------
 
@@ -149,6 +193,7 @@ class ServeMetrics:
         — decode throughput is a separate number (below)."""
         with self._lock:
             self._ttft_ms.add(ttft_ms)
+        self._h_ttft.observe(ttft_ms / 1e3)
 
     def on_tokens(self, n: int = 1) -> None:
         with self._lock:
@@ -173,15 +218,22 @@ class ServeMetrics:
             self.generations_total += 1
             if n_tokens > 1 and seconds > 0:
                 self._tps_user.add((n_tokens - 1) / seconds)
+                self._h_tps.observe((n_tokens - 1) / seconds)
 
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> Dict:
         """The ``/stats`` dict: plain ints/floats/None only (json-ready)."""
+        from ..version import __version__
         with self._lock:
             fill = (self.batch_live_rows_total / self.batch_rows_total
                     if self.batch_rows_total else None)
             return {
+                # Operator context first: how long this engine has been
+                # up (rate denominators, restart detection) and what
+                # build produced these numbers.
+                "uptime_seconds": time.monotonic() - self._t0,
+                "horovod_tpu_version": __version__,
                 "requests_total": self.requests_total,
                 "responses_total": self.responses_total,
                 "rejected_overload": self.rejected_overload,
@@ -224,3 +276,122 @@ class ServeMetrics:
                     "tokens_per_sec_user_p99": self._tps_user.quantile(0.99),
                 },
             }
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition of the serving plane (the /metrics route).
+#
+# Everything /stats knows, renamed onto the stable hvd_* series inventory
+# (docs/observability.md) and merged with the ServeMetrics histograms.
+# The mapping is explicit, not a generic dict walker: metric names are an
+# API, and a renamed snapshot key must break HERE (a KeyError in tests),
+# not silently rename a series every dashboard keys on.
+# ---------------------------------------------------------------------------
+
+# snapshot key -> (series name, type, help)
+_TOP = {
+    "uptime_seconds": ("hvd_uptime_seconds", "gauge",
+                       "Seconds since this engine's metrics started"),
+    "requests_total": ("hvd_requests_total", "counter",
+                       "Requests admitted to the queue"),
+    "responses_total": ("hvd_responses_total", "counter",
+                        "Requests answered successfully"),
+    "rejected_overload": ("hvd_rejected_overload_total", "counter",
+                          "Requests rejected at the door (all reasons)"),
+    "expired_deadline": ("hvd_expired_deadline_total", "counter",
+                         "Requests dropped at dequeue past deadline"),
+    "cancelled_shutdown": ("hvd_cancelled_shutdown_total", "counter",
+                           "Requests cancelled by non-drain shutdown"),
+    "batches_total": ("hvd_batches_total", "counter",
+                      "Device batches executed"),
+    "batch_rows_total": ("hvd_batch_rows_total", "counter",
+                         "Bucket slots executed (padding included)"),
+    "batch_live_rows_total": ("hvd_batch_live_rows_total", "counter",
+                              "Live request rows executed"),
+    "batch_fill_ratio": ("hvd_batch_fill_ratio", "gauge",
+                         "Live rows / executed rows (cumulative)"),
+    "queue_depth": ("hvd_queue_depth", "gauge",
+                    "Admission queue depth at last event"),
+    "max_queue": ("hvd_max_queue", "gauge", "Admission queue capacity"),
+    "max_slots": ("hvd_max_slots", "gauge", "Decode slots configured"),
+    "max_len": ("hvd_max_len", "gauge", "KV positions per stream"),
+    "active_slots": ("hvd_active_slots", "gauge",
+                     "Streams mid-generation right now"),
+    "peak_active_slots": ("hvd_peak_active_slots", "gauge",
+                          "High-water concurrent streams"),
+    "prefix_hit_rate": ("hvd_prefix_hit_rate", "gauge",
+                        "Prefix-cache lookup hit rate"),
+    "block_size": ("hvd_kv_block_size", "gauge",
+                   "Tokens per KV block (paged layout)"),
+}
+
+_GENERATION = {
+    "generations_total": ("hvd_generations_total", "counter",
+                          "Generation streams finished"),
+    "tokens_generated_total": ("hvd_tokens_generated_total", "counter",
+                               "Tokens sampled across all streams"),
+    "prefix_hits_total": ("hvd_prefix_hits_total", "counter",
+                          "Prefix-cache lookups with >=1 resident block"),
+    "prefix_misses_total": ("hvd_prefix_misses_total", "counter",
+                            "Prefix-cache lookups with no resident block"),
+    "prefix_hit_blocks_total": ("hvd_prefix_hit_blocks_total", "counter",
+                                "Prompt blocks served from the prefix "
+                                "cache"),
+    "prefix_lookup_blocks_total": ("hvd_prefix_lookup_blocks_total",
+                                   "counter",
+                                   "Prompt blocks looked up"),
+}
+
+_BLOCKS = {
+    "total": ("hvd_kv_blocks_total", "gauge",
+              "Usable KV blocks in the pool"),
+    "free": ("hvd_kv_blocks_free", "gauge", "KV blocks free right now"),
+    "used": ("hvd_kv_blocks_used", "gauge", "KV blocks allocated"),
+    "registered_prefix_blocks": ("hvd_kv_prefix_registered_blocks",
+                                 "gauge",
+                                 "Blocks pinned by the prefix registry"),
+}
+
+
+def collect_stats(snap: Dict, registry: MetricsRegistry,
+                  engine: str) -> Tuple[Meta, List[Sample]]:
+    """One engine's ``(meta, samples)`` for the exposition renderer:
+    the ``/stats`` snapshot mapped onto the stable series names, the
+    rejection split as a labeled counter, the build info, and the
+    registry's histograms — every sample carrying ``engine=<label>`` so
+    two engines merge into one valid scrape."""
+    labels = {"engine": engine}
+    meta: Meta = {}
+    samples: List[Sample] = []
+
+    def _emit(table: Dict, src: Dict) -> None:
+        for key, (name, typ, help_) in table.items():
+            v = src.get(key)
+            if v is None or isinstance(v, bool) or not isinstance(
+                    v, (int, float)):
+                continue
+            meta[name] = (typ, help_)
+            samples.append((name, dict(labels), float(v)))
+
+    _emit(_TOP, snap)
+    _emit(_GENERATION, snap.get("generation") or {})
+    _emit(_BLOCKS, snap.get("blocks") or {})
+    meta["hvd_rejected_total"] = (
+        "counter", "Door rejections split by the scarce resource")
+    for reason_key, reason in (("rejected_slots_full", "slots_full"),
+                               ("rejected_blocks_exhausted",
+                                "blocks_exhausted")):
+        if reason_key in snap:
+            samples.append(("hvd_rejected_total",
+                            {**labels, "reason": reason},
+                            float(snap[reason_key])))
+    version = snap.get("horovod_tpu_version")
+    if version:
+        meta["hvd_build_info"] = (
+            "gauge", "Constant 1, labeled with the serving build")
+        samples.append(("hvd_build_info",
+                        {**labels, "version": str(version)}, 1.0))
+    h_meta, h_samples = registry.collect(const_labels=labels)
+    meta.update(h_meta)
+    samples.extend(h_samples)
+    return meta, samples

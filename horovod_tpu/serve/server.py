@@ -20,6 +20,9 @@ status codes so clients see conventional semantics:
   exactly as ``/predict`` (the stream only starts once the first token
   exists, so deadline/overload failures still get real status codes).
 * ``GET /stats`` → 200, the engine's snapshot dict as JSON
+* ``GET /metrics`` → 200, the same numbers in Prometheus text
+  exposition (stable ``hvd_*`` series, ``engine=`` label per attached
+  engine; ``docs/observability.md`` holds the inventory)
 * ``GET /healthz`` → readiness probe: **503** before ``warmup()``
   completes and once drain/shutdown begins, 200 with the current queue
   depth otherwise — so a load balancer stops routing to a cold engine
@@ -71,6 +74,27 @@ class _Handler(BaseHTTPRequestHandler):
             if self.engine is not None and self.gen_engine is not None:
                 snap["generate"] = self.gen_engine.stats()
             self._reply(200, snap)
+        elif path == "/metrics":
+            # Prometheus text exposition: everything /stats knows, on
+            # the stable hvd_* series names. With both engines attached
+            # the samples MERGE before rendering (each carries its
+            # engine= label) — concatenating two renders would repeat
+            # # TYPE lines and split name groups, which the exposition
+            # format forbids.
+            meta, samples = {}, []
+            for eng in (self.engine, self.gen_engine):
+                if eng is not None:
+                    m, s = eng.prom_collect()
+                    meta.update(m)
+                    samples.extend(s)
+            from ..obs.registry import render
+            body = render(meta, samples).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif path == "/healthz":
             ready, status, depth = self._primary().health()
             if ready and self.gen_engine is not None \

@@ -236,6 +236,20 @@ def _my_rank() -> int:
 
 def _fire(fault: Fault) -> None:
     tag = f"epoch {_restart_epoch()} step {fault.step}"
+    from ..obs import flightrec
+    flightrec.record("fault", action=fault.action, rank=_my_rank(),
+                     step=fault.step)
+    if fault.action in ("kill", "exit"):
+        # Flight-recorder dump BEFORE the trigger: SIGKILL is untrappable
+        # by the kernel's contract, so the drilled rank's own ring would
+        # otherwise be lost. A real preemption delivers SIGTERM first
+        # (which the obs.flightrec signal hook catches); the injector
+        # stands in for that notice — the drill's "dead" rank leaves the
+        # same hvd_flightrec.rank{N}.json a preempted rank would, naming
+        # its final completed step. Survivors additionally dump on the
+        # WorkerFailureError the abort hands them.
+        flightrec.dump(reason=f"fault injection: {fault.action} at {tag}")
+        flightrec.run_crash_hooks()
     if fault.action == "kill":
         print(f"[faults] rank {_my_rank()}: SIGKILL at {tag}", flush=True)
         os.kill(os.getpid(), signal.SIGKILL)
@@ -412,6 +426,9 @@ def resize_hook(step: int, world_size: int) -> Optional[int]:
         print(f"[faults] rank {_my_rank()}: injecting live resize "
               f"{world_size} -> {target} at epoch {epoch} step {step}",
               flush=True)
+        from ..obs import flightrec
+        flightrec.record("fault", action="resize", step=step,
+                         world=world_size, target=target)
         return target
     return None
 

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import runtime
+from .obs import flightrec as _flightrec
+from .obs.registry import registry as _metrics_registry
 from .testing import faults as _faults
 from .training import TrainState, make_batch_placer, shard_batch
 from .utils import timeline as _timeline
@@ -83,6 +85,31 @@ class Trainer:
         self.resize = resize
         self._bad_counter = None
         self._bad_add = None
+        # Hot-path metrics (horovod_tpu.obs): registered once here — a
+        # per-step registry lookup would be dict hashing on the hot loop
+        # for nothing. Names are API (docs/observability.md).
+        reg = _metrics_registry()
+        self._m_steps = reg.counter(
+            "hvd_steps_total",
+            "Train steps completed by this rank's loop (skipped "
+            "bad steps included — they consumed a batch)")
+        self._m_step_seconds = reg.histogram(
+            "hvd_step_seconds",
+            "Per-step wall time: input wait + dispatch + host-side work "
+            "between consecutive step completions")
+        self._m_samples = reg.counter(
+            "hvd_samples_total",
+            "Training examples consumed (leading batch-axis rows seen "
+            "by this process's loop)")
+        self._m_bad = reg.counter(
+            "hvd_bad_steps_total",
+            "Steps skipped by the non-finite gradient guard")
+        self._m_epochs = reg.counter("hvd_epochs_total",
+                                     "Epochs completed")
+        self._m_gstep = reg.gauge(
+            "hvd_global_step",
+            "Global step counter (across epochs and restarts of this "
+            "process)")
 
     def _stream(self, data: Iterable):
         from .data import prefetch_to_device, shard_iterator
@@ -137,6 +164,9 @@ class Trainer:
         tl = runtime.world().timeline if runtime.is_initialized() else None
         with _timeline.maybe_op(tl, "train.guard", _timeline.BAD_STEP):
             pass  # instantaneous marker: this step was skipped
+        self._m_bad.inc()
+        _flightrec.record("bad_step", step=self._global_step,
+                          consecutive=consec)
         if self.verbose:
             print(f"[trainer] non-finite gradients at global step "
                   f"{self._global_step}: update skipped "
@@ -187,6 +217,8 @@ class Trainer:
             self.state, params=es.params, opt_state=es.opt_state,
             step=jnp.asarray(es.step, self.state.step.dtype))
         self._bad_counter = jnp.zeros((), jnp.int32)
+        _flightrec.record("rollback", step=es.step,
+                          consecutive_bad=consec)
         if self.verbose:
             print(f"[trainer] bad-step budget exhausted ({consec} "
                   f"consecutive skips) — rolled back to verified "
@@ -226,6 +258,9 @@ class Trainer:
             step=jnp.asarray(rc.state.step, self.state.step.dtype))
         if rebuilt.train_step is not None:
             self.train_step = rebuilt.train_step
+        _flightrec.record("resize_executed", step=int(self.state.step),
+                          world=runtime.size()
+                          if runtime.is_initialized() else None)
         # Mesh-tied host-side caches die with the old world.
         self._eval_placer = None
         self._metric_add = None
@@ -269,6 +304,7 @@ class Trainer:
             resized_early = False
             metric_sums = None
             stream = self._stream(data())
+            step_t0 = time.perf_counter()
             try:
                 for batch_idx, batch in enumerate(stream):
                     if self.steps_per_epoch is not None \
@@ -291,6 +327,28 @@ class Trainer:
                     for cb in callbacks:
                         cb.on_batch_end(batch_idx)
                     nsteps += 1
+                    # Telemetry: per-step wall time (completion to
+                    # completion — input wait included, it is the
+                    # number an operator acts on), throughput counters,
+                    # and one flight-recorder event naming the step a
+                    # post-mortem will call "last completed".
+                    now = time.perf_counter()
+                    self._m_step_seconds.observe(now - step_t0)
+                    step_t0 = now
+                    self._m_steps.inc()
+                    # Post-increment count: the gauge reads "steps this
+                    # process has completed" (the fleet poller's
+                    # straggler spread keys on it).
+                    self._m_gstep.set(self._global_step + 1)
+                    try:
+                        rows = int(np.shape(
+                            jax.tree_util.tree_leaves(batch)[0])[0])
+                    except (IndexError, TypeError):
+                        rows = 0
+                    if rows:
+                        self._m_samples.inc(rows)
+                    _flightrec.record("step", step=self._global_step,
+                                      epoch=epoch)
                     _faults.step_hook(self._global_step)
                     self._global_step += 1
                     if self.resize is not None and self._maybe_resize():
@@ -344,6 +402,7 @@ class Trainer:
                             r * np.asarray(e[k]) for r, e in evals) / total)
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs)
+            self._m_epochs.inc()
             self.history.append(logs)
             if self.verbose:
                 dt = time.perf_counter() - t0

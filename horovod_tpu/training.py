@@ -844,6 +844,40 @@ def _env_wire_cast(payload, wire_np):
     return payload, None
 
 
+_env_exchange_metrics = None
+
+
+def _obs_exchange(n_submits: int, n_bytes: int, tag: int) -> None:
+    """Host-plane collective telemetry for the env-world step: the
+    compiled planes' collectives live inside XLA where nothing host-side
+    can count them, but here every exchange IS a host submit — one
+    counter bump per step (aggregated, not per bucket) plus a
+    flight-recorder event, so a dead rank's post-mortem shows whether it
+    died inside an exchange and how much wire the job was moving.
+
+    ``tag`` is the 1-based exchange counter (the collective-name
+    namespace), NOT the trainer's global step — the event deliberately
+    records it under ``tag=`` so a dump's ``last_step`` (derived from
+    the newest ``step``-bearing event) never misreports an exchange
+    tag as a completed training step."""
+    global _env_exchange_metrics
+    if _env_exchange_metrics is None:
+        from .obs.registry import registry as _registry_fn
+        reg = _registry_fn()
+        _env_exchange_metrics = (
+            reg.counter("hvd_collective_submits_total",
+                        "Host-plane collective submissions (env-world "
+                        "gradient/metric exchanges)"),
+            reg.counter("hvd_collective_bytes_total",
+                        "Bytes submitted to host-plane collectives "
+                        "(post wire-cast, padding included)"))
+    _env_exchange_metrics[0].inc(n_submits)
+    _env_exchange_metrics[1].inc(n_bytes)
+    from .obs import flightrec
+    flightrec.record("exchange", tag=tag, submits=n_submits,
+                     bytes=n_bytes)
+
+
 def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                          metrics_fn, accum_steps: int = 1,
                          accum_unroll: Optional[int] = None,
@@ -954,6 +988,7 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         buckets = plan_buckets(leaves)
         handles = []
         wire_origs = []
+        xbytes = 0
         for bi, bucket in enumerate(buckets):
             if len(bucket) == 1:
                 payload = np.asarray(leaves[bucket[0]])
@@ -962,6 +997,7 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                     [np.ravel(np.asarray(leaves[j])) for j in bucket])
             payload, orig = _env_wire_cast(payload, wire_np)
             wire_origs.append(orig)
+            xbytes += payload.nbytes
             handles.append(w.coord.submit(
                 "allreduce", payload, f"grad.{tag}.{bi}", op=Op.AVERAGE))
         metric_handles = {"loss": w.coord.submit(
@@ -971,6 +1007,7 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
             metric_handles[k] = w.coord.submit(
                 "allreduce", np.asarray(v, np.float32),
                 f"metric.{k}.{tag}", op=Op.AVERAGE)
+        _obs_exchange(len(handles) + len(metric_handles), xbytes, tag)
 
         reduced = [None] * len(leaves)
         all_finite = True
@@ -1100,6 +1137,7 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
 
         handles = []
         wire_origs = []
+        xbytes = 0
         for bi, bucket in enumerate(plan.buckets):
             if len(bucket) == 1:
                 flat = np.ravel(np.asarray(leaves[bucket[0]]))
@@ -1119,6 +1157,7 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
             pad = plan.padded[bi] - plan.sizes[bi]
             if pad:
                 flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+            xbytes += flat.nbytes
             handles.append(w.coord.submit(
                 "reducescatter", flat, f"zgrad.{tag}.{bi}",
                 op=Op.AVERAGE))
@@ -1129,6 +1168,7 @@ def _make_env_world_zero_step(dist_opt, grads_jit, counter, w,
             metric_handles[k] = w.coord.submit(
                 "allreduce", np.asarray(v, np.float32),
                 f"metric.{k}.{tag}", op=Op.AVERAGE)
+        _obs_exchange(len(handles) + len(metric_handles), xbytes, tag)
 
         shards = [np.asarray(w.coord.wait(h)) for h in handles]
         shards = [s if wire_origs[bi] is None

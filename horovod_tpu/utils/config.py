@@ -119,6 +119,14 @@ def max_bad_steps() -> int:
     return max(1, _int_env("HVD_MAX_BAD_STEPS", DEFAULT_MAX_BAD_STEPS))
 
 
+def metrics_port() -> int:
+    """``HVD_METRICS_PORT`` — base port of the per-rank metrics HTTP
+    listeners (rank *r* serves ``GET /metrics`` on ``base + r``; see
+    :mod:`horovod_tpu.obs.http`). 0/unset disables — training jobs pay
+    nothing unless an operator asks for the scrape surface."""
+    return max(0, _int_env("HVD_METRICS_PORT", 0))
+
+
 def stall_warning_secs() -> float:
     raw = os.environ.get("HOROVOD_STALL_CHECK_TIME")
     if raw:

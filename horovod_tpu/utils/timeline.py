@@ -28,8 +28,10 @@ profiler, which this trace is designed to be merged with.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
+import os
 import threading
 import time
 from typing import Iterable, Optional
@@ -95,6 +97,12 @@ class Timeline:
         self._depth: dict[str, int] = {}
         self._last_flush = self._start
         self._closed = False
+        # Crash safety: the ~1 s flush cadence means a killed rank loses
+        # the buffered tail of its trace — the very events that explain
+        # the death. An atexit close catches normal-but-uncloseed exits;
+        # the fatal-signal path is covered by the flight recorder's
+        # crash hooks (runtime.init registers self.flush there).
+        atexit.register(self.close)
 
     # -- low-level ---------------------------------------------------------
 
@@ -110,6 +118,22 @@ class Timeline:
             if now - self._last_flush > self.FLUSH_INTERVAL_SECS:
                 self._file.flush()
                 self._last_flush = now
+
+    def flush(self, fsync: bool = True) -> None:
+        """Push buffered events to disk NOW (fsync by default): called
+        from error paths (:meth:`abort`) and crash hooks, where "the OS
+        probably would have written it" is not good enough — the reader
+        is a post-mortem."""
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._file.flush()
+                if fsync:
+                    os.fsync(self._file.fileno())
+            except (OSError, ValueError):
+                pass  # a dying process keeps dying
+            self._last_flush = time.monotonic()
 
     def _pid(self, tensor_name: str) -> int:
         pid = self._pids.get(tensor_name)
@@ -225,6 +249,7 @@ class Timeline:
         pid = self._pid(tensor_name)
         if state == _State.NEGOTIATING:
             self.negotiate_end(tensor_name)
+            self.flush(fsync=True)
             return
         while self._depth.get(tensor_name, 0) > 0:
             self.activity_end(tensor_name)
@@ -234,6 +259,10 @@ class Timeline:
             ev["args"] = {"error": error}
         self._states[tensor_name] = _State.UNKNOWN
         self._emit(ev)
+        # An abort usually precedes a death (dispatch failure, world
+        # ABORT): make the trace durable now instead of trusting the
+        # 1 s cadence to get another turn.
+        self.flush(fsync=True)
 
     # -- scoped helpers (serving plane) ------------------------------------
     #
@@ -272,6 +301,10 @@ class Timeline:
             if self._closed:
                 return
             self._closed = True
+            try:
+                atexit.unregister(self.close)
+            except Exception:  # noqa: BLE001 — interpreter may be exiting
+                pass
             # Chrome's trace viewer tolerates the trailing comma; close the
             # array for strict-JSON consumers.
             self._file.write("{}]\n")

@@ -1,0 +1,85 @@
+"""Telemetry-leg driver (ISSUE 12): an env-world ``Trainer.fit`` job
+whose whole purpose is to be OBSERVED while running.
+
+Unlike the other workers (bare allreduce loops), this one goes through
+the real ``Trainer`` hot path, so each rank exports the full training
+metric surface — ``hvd_steps_total``, the ``hvd_step_seconds``
+histogram, ``hvd_samples_total``, ``hvd_global_step``, the env-world
+``hvd_collective_*`` counters — on its ``HVD_METRICS_PORT + rank``
+listener, records step events into the flight recorder, and (under a
+``rank=N:kill`` drill) leaves ``hvd_flightrec.rank{N}.json`` naming the
+final completed step.
+
+Env:
+  HVD_TOTAL_STEPS     steps to train (default 8)
+  HVD_STEP_SLEEP_MS   per-batch host sleep so scrapes land on a live job
+  HVD_METRICS_PORT    per-rank /metrics listeners (ci scrapes them)
+  HVD_FLIGHTREC_DIR   flight-recorder dump directory
+  HVD_FAULT_SPEC      fault injection (Trainer.fit polls step_hook)
+
+Prints ``rank <r>/<s>: FINAL steps <n>`` on success.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import runtime, training  # noqa: E402
+from horovod_tpu.elastic import RECOVERABLE  # noqa: E402
+from horovod_tpu.trainer import Trainer  # noqa: E402
+
+TOTAL_STEPS = int(os.environ.get("HVD_TOTAL_STEPS", "8"))
+STEP_SLEEP_MS = int(os.environ.get("HVD_STEP_SLEEP_MS", "0"))
+
+
+class M(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        return nn.Dense(4)(x)
+
+
+def main():
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    state, opt = training.create_train_state(
+        M(), jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.sgd(0.05))
+    step = training.make_train_step(M(), opt, donate=False)
+
+    def data():
+        # Same seed on every rank = one agreed global batch per step;
+        # Trainer's shard_iterator slices this rank's rows.
+        rng = np.random.RandomState(42)
+        for _ in range(TOTAL_STEPS):
+            if STEP_SLEEP_MS:
+                time.sleep(STEP_SLEEP_MS / 1000.0)
+            yield (rng.randn(8 * s, 8).astype(np.float32),
+                   rng.randint(0, 4, (8 * s,)))
+
+    trainer = Trainer(step, state, prefetch=0, verbose=(r == 0))
+    try:
+        trainer.fit(data, epochs=1)
+    except RECOVERABLE as e:
+        # The post-mortem path the ci kill drill pins: shutdown(error=)
+        # dumps this rank's flight recorder (the coordination client
+        # already dumped once when the ABORT surfaced).
+        print(f"rank {r}/{s}: world failure: {e}", flush=True)
+        runtime.shutdown(error=e)
+        sys.exit(1)
+    print(f"rank {r}/{s}: FINAL steps {trainer._global_step}", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

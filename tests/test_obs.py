@@ -1,0 +1,538 @@
+"""One telemetry plane (ISSUE 12): the metrics registry
+(counter/gauge/histogram semantics, exposition golden lines, label
+escaping), the per-rank /metrics listener, the crash-safe flight
+recorder (ring bound, dump format, the faults.py kill-drill dump, the
+runtime.shutdown(error=) trigger), /metrics on BOTH serving engines,
+the tpurun --metrics-summary fleet line, and the timeline crash-flush
+satellite.
+
+Budget-conscious (tier-1 sits ~430s of its 870s cap): no subprocess
+legs — the kill drill fires in-process with os.kill monkeypatched; the
+generation engine is the same tiny module-scoped model as
+tests/test_paged_kv.py with ONE prefill bucket; assertions on the
+process-global default registry use DELTAS (other tests' Trainers share
+it). The end-to-end curl-a-live-rank and real-SIGKILL drills live in
+ci.sh, not here.
+"""
+
+import json
+import os
+import signal
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import obs, serve
+from horovod_tpu.obs import flightrec
+from horovod_tpu.obs.http import MetricsListener, start_from_env
+from horovod_tpu.obs.registry import (DEFAULT_BUCKETS, MetricsRegistry,
+                                      parse_exposition, render)
+from horovod_tpu.obs.summary import FleetPoller
+from horovod_tpu.parallel.transformer import TransformerConfig, init_params
+from horovod_tpu.testing import faults
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics + exposition format
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_semantics(self):
+        r = MetricsRegistry()
+        c = r.counter("hvd_t_total", "things")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_semantics(self):
+        r = MetricsRegistry()
+        g = r.gauge("hvd_g", "a gauge")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value == 3.0
+
+    def test_histogram_cumulative_buckets(self):
+        r = MetricsRegistry()
+        h = r.histogram("hvd_lat_seconds", "lat", buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum, total_sum, count = h.snapshot()
+        assert cum == ((0.1, 1), (1, 2), (10, 3))
+        assert count == 4 and total_sum == pytest.approx(55.55)
+
+    def test_registration_idempotent_and_kind_conflict(self):
+        r = MetricsRegistry()
+        a = r.counter("hvd_x_total", "x")
+        assert r.counter("hvd_x_total") is a
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("hvd_x_total")
+        with pytest.raises(ValueError, match="invalid metric name"):
+            r.counter("bad-name")
+
+    def test_labels(self):
+        r = MetricsRegistry()
+        c = r.counter("hvd_rej_total", "rejections", labels=("reason",))
+        c.labels(reason="slots_full").inc(2)
+        c.labels(reason="blocks_exhausted").inc()
+        assert c.labels(reason="slots_full").value == 2
+        with pytest.raises(ValueError, match="takes labels"):
+            c.labels(nope="x")
+        with pytest.raises(ValueError):
+            r.counter("hvd_y_total", labels=("le rouge",))
+
+    def test_exposition_golden_lines(self):
+        """The exact wire format a Prometheus scraper parses — TYPE/HELP
+        header once per metric, cumulative le= buckets, +Inf, sum/count."""
+        r = MetricsRegistry()
+        r.counter("hvd_steps_total", "Steps done").inc(7)
+        h = r.histogram("hvd_step_seconds", "Step wall time",
+                        buckets=(0.5, 2))
+        h.observe(0.3)
+        h.observe(1.0)
+        text = r.render(const_labels={"rank": "3"})
+        for line in (
+                "# HELP hvd_steps_total Steps done",
+                "# TYPE hvd_steps_total counter",
+                'hvd_steps_total{rank="3"} 7',
+                "# TYPE hvd_step_seconds histogram",
+                'hvd_step_seconds_bucket{rank="3",le="0.5"} 1',
+                'hvd_step_seconds_bucket{rank="3",le="2"} 2',
+                'hvd_step_seconds_bucket{rank="3",le="+Inf"} 2',
+                'hvd_step_seconds_count{rank="3"} 2'):
+            assert line in text.splitlines(), f"missing {line!r}:\n{text}"
+        assert text.count("# TYPE hvd_steps_total") == 1
+
+    def test_label_escaping_roundtrip(self):
+        r = MetricsRegistry()
+        g = r.gauge("hvd_info", "info", labels=("path",))
+        # Includes a literal backslash FOLLOWED BY n: an ordered
+        # str.replace unescape would eat it as a newline.
+        nasty = 'a"b\\c\nnewline C:\\new'
+        g.labels(path=nasty).set(1)
+        text = r.render()
+        assert '\\"' in text and "\\n" in text and "\\\\" in text
+        parsed = parse_exposition(text)
+        assert parsed[("hvd_info", (("path", nasty),))] == 1.0
+
+    def test_histogram_bucket_conflict_raises(self):
+        r = MetricsRegistry()
+        h = r.histogram("hvd_h_seconds", "h", buckets=(0.1, 1, 10))
+        # Same bounds (any spelling) -> same family; different -> raise.
+        assert r.histogram("hvd_h_seconds", buckets=[10, 1, 0.1]) is h
+        with pytest.raises(ValueError, match="buckets"):
+            r.histogram("hvd_h_seconds", buckets=(1, 2))
+
+    def test_parse_exposition_values(self):
+        parsed = parse_exposition(
+            "# TYPE x counter\nx 3\ny{a=\"1\"} 2.5\n"
+            "z_bucket{le=\"+Inf\"} 4\ngarbage line here ! !\n")
+        assert parsed[("x", ())] == 3.0
+        assert parsed[("y", (("a", "1"),))] == 2.5
+        assert parsed[("z_bucket", (("le", "+Inf"),))] == 4.0
+
+    def test_render_merges_groups(self):
+        """Two engines' samples with the same name must render as ONE
+        block with one TYPE line (the format forbids split groups) —
+        the /metrics route's merge contract."""
+        meta = {"hvd_requests_total": ("counter", "req")}
+        samples = [("hvd_requests_total", {"engine": "predict"}, 1.0),
+                   ("hvd_other", {}, 2.0),
+                   ("hvd_requests_total", {"engine": "generate"}, 3.0)]
+        text = render(meta, samples)
+        assert text.count("# TYPE hvd_requests_total counter") == 1
+        lines = text.splitlines()
+        i = lines.index('hvd_requests_total{engine="predict"} 1')
+        assert lines[i + 1] == 'hvd_requests_total{engine="generate"} 3'
+
+    def test_default_buckets_are_finite_and_sorted(self):
+        assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+        assert all(np.isfinite(b) for b in DEFAULT_BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bound_and_last_step(self, tmp_path):
+        fr = obs.FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record("step", step=i)
+        fr.record("abort", error="rank 2 died")
+        path = fr.dump("test reason", directory=str(tmp_path), rank=5)
+        rec = json.loads(open(path).read())
+        assert rec["rank"] == 5
+        assert rec["reason"] == "test reason"
+        assert rec["n_events"] == 4
+        assert rec["last_step"] == 9
+        assert rec["events"][-1]["kind"] == "abort"
+        assert os.path.basename(path) == "hvd_flightrec.rank5.json"
+
+    def test_dump_overwrites(self, tmp_path):
+        fr = obs.FlightRecorder()
+        fr.record("step", step=1)
+        p1 = fr.dump("first", directory=str(tmp_path), rank=0)
+        fr.record("step", step=2)
+        p2 = fr.dump("second", directory=str(tmp_path), rank=0)
+        assert p1 == p2
+        rec = json.loads(open(p2).read())
+        assert rec["reason"] == "second" and rec["last_step"] == 2
+
+    def test_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HVD_FLIGHTREC_EVENTS", "0")
+        before = len(flightrec.recorder().events())
+        flightrec.record("step", step=1)
+        assert len(flightrec.recorder().events()) == before
+        assert flightrec.dump("x", directory=str(tmp_path)) is None
+
+    def test_crash_hooks(self):
+        calls = []
+        hook = lambda: calls.append(1)  # noqa: E731
+        bad = lambda: 1 / 0             # noqa: E731
+        flightrec.add_crash_hook(hook)
+        flightrec.add_crash_hook(bad)
+        try:
+            flightrec.run_crash_hooks()   # bad hook must not abort the walk
+            assert calls == [1]
+        finally:
+            flightrec.remove_crash_hook(hook)
+            flightrec.remove_crash_hook(bad)
+
+    def test_kill_drill_dumps_before_trigger(self, tmp_path, monkeypatch):
+        """The faults.py kill drill: the injected SIGKILL is untrappable,
+        so the injector dumps the ring FIRST — the drilled rank leaves
+        hvd_flightrec.rank{N}.json naming its final step (the ci.sh leg
+        pins the same contract through a real subprocess world)."""
+        hvd.init()
+        monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+        monkeypatch.setenv("HVD_FAULT_SPEC", "rank=0:kill@step=6")
+        killed = {}
+
+        def _fake_kill(pid, sig):
+            killed["sig"] = sig
+            raise KeyboardInterrupt("drill")   # stand-in for the death
+
+        monkeypatch.setattr(os, "kill", _fake_kill)
+        faults.reset()
+        flightrec.record("step", step=6)
+        with pytest.raises(KeyboardInterrupt):
+            faults.step_hook(6)
+        assert killed["sig"] == signal.SIGKILL
+        rank = hvd.world().process_index
+        path = tmp_path / f"hvd_flightrec.rank{rank}.json"
+        rec = json.loads(path.read_text())
+        assert rec["last_step"] == 6
+        assert "kill" in rec["reason"]
+        assert rec["events"][-1]["kind"] == "fault"
+        assert rec["events"][-1]["action"] == "kill"
+
+    def test_shutdown_error_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+        hvd.init()
+        rank = hvd.world().process_index
+        flightrec.record("step", step=33)
+        hvd.shutdown(error=RuntimeError("worker died"))
+        rec = json.loads(
+            (tmp_path / f"hvd_flightrec.rank{rank}.json").read_text())
+        assert rec["last_step"] == 33
+        assert "worker died" in rec["reason"]
+
+    def test_plain_shutdown_does_not_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+        hvd.init()
+        hvd.shutdown()
+        assert not list(tmp_path.iterdir())
+
+
+# ---------------------------------------------------------------------------
+# Per-rank HTTP listener
+# ---------------------------------------------------------------------------
+
+class TestListener:
+    def test_serves_registry(self):
+        reg = MetricsRegistry()
+        reg.counter("hvd_up_total", "up").inc(4)
+        with MetricsListener(render=reg.render) as lst:
+            url = f"http://127.0.0.1:{lst.port}"
+            body = urllib.request.urlopen(f"{url}/metrics").read().decode()
+            assert "hvd_up_total 4" in body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{url}/nope")
+            assert ei.value.code == 404
+
+    def test_start_from_env_port_plus_rank(self, monkeypatch):
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        monkeypatch.setenv("HVD_METRICS_PORT", str(base - 2))
+        monkeypatch.setenv("HVD_METRICS_HOST", "127.0.0.1")
+        lst = start_from_env(rank=2)
+        assert lst is not None and lst.port == base
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{base}/metrics").read().decode()
+            assert 'rank="2"' in body
+        finally:
+            lst.stop()
+
+    def test_start_from_env_disabled(self, monkeypatch):
+        monkeypatch.delenv("HVD_METRICS_PORT", raising=False)
+        assert start_from_env(rank=0) is None
+
+    def test_start_from_env_bind_failure_warns(self, monkeypatch):
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        taken = s.getsockname()[1]
+        s.listen(1)
+        monkeypatch.setenv("HVD_METRICS_PORT", str(taken))
+        monkeypatch.setenv("HVD_METRICS_HOST", "127.0.0.1")
+        try:
+            with pytest.warns(UserWarning, match="could not bind"):
+                assert start_from_env(rank=0) is None
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# Trainer instrumentation (deltas: the default registry is process-global)
+# ---------------------------------------------------------------------------
+
+class TestTrainerInstrumentation:
+    def test_step_metrics_and_flight_events(self):
+        import flax.linen as nn
+        import optax
+        from horovod_tpu import training
+        from horovod_tpu.trainer import Trainer
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                return nn.Dense(4)(x)
+
+        hvd.init()
+        state, opt = training.create_train_state(
+            M(), jax.random.PRNGKey(0), jnp.zeros((2, 8)),
+            optax.sgd(1e-2))
+        step = training.make_train_step(M(), opt, donate=False)
+        rng = np.random.RandomState(0)
+
+        def data():
+            for _ in range(3):
+                yield (rng.randn(16, 8).astype(np.float32),
+                       rng.randint(0, 4, (16,)))
+
+        reg = obs.registry()
+        steps0 = reg.counter("hvd_steps_total").value
+        samples0 = reg.counter("hvd_samples_total").value
+        hist0 = reg.histogram("hvd_step_seconds").count
+        epochs0 = reg.counter("hvd_epochs_total").value
+        tr = Trainer(step, state, prefetch=0)
+        tr.fit(data, epochs=2)
+        assert reg.counter("hvd_steps_total").value == steps0 + 6
+        assert reg.counter("hvd_samples_total").value == samples0 + 96
+        assert reg.histogram("hvd_step_seconds").count == hist0 + 6
+        assert reg.counter("hvd_epochs_total").value == epochs0 + 2
+        assert reg.gauge("hvd_global_step").value == tr._global_step
+        evs = flightrec.recorder().events()
+        assert any(e["kind"] == "step" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# Serving plane: /metrics on both engines + ServeMetrics satellites
+# ---------------------------------------------------------------------------
+
+CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+
+@pytest.fixture(scope="module")
+def predict_engine():
+    eng = serve.Engine(lambda v, x: x * v["w"], {"w": np.float32(2.0)},
+                       item_shape=(4,),
+                       config=serve.ServeConfig(max_batch=4))
+    eng.warmup()
+    eng.infer(np.ones(4, np.float32))
+    yield eng
+    eng.shutdown()
+
+
+@pytest.fixture(scope="module")
+def gen_engine():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = serve.GenerationEngine(params, cfg, serve.GenerationConfig(
+        max_slots=2, max_len=16, default_max_new_tokens=4,
+        kv_layout="paged", block_size=4))
+    eng.warmup()
+    eng.generate([3, 1, 4, 1, 5], timeout=60)
+    yield eng
+    eng.shutdown()
+
+
+class TestServeMetricsRoute:
+    def test_predict_engine_exposition(self, predict_engine):
+        parsed = parse_exposition(predict_engine.prom_metrics())
+        assert parsed[("hvd_requests_total",
+                       (("engine", "predict"),))] >= 1
+        assert any(k[0] == "hvd_request_seconds_bucket" for k in parsed)
+        assert any(k[0] == "hvd_uptime_seconds" for k in parsed)
+
+    def test_generation_engine_exposition(self, gen_engine):
+        text = gen_engine.prom_metrics()
+        parsed = parse_exposition(text)
+        # The named series the ci.sh telemetry leg curls for.
+        assert any(k[0] == "hvd_generate_ttft_seconds_bucket"
+                   for k in parsed), text[:800]
+        blocks = {k[0]: v for k, v in parsed.items()}
+        assert blocks["hvd_kv_blocks_free"] == blocks["hvd_kv_blocks_total"]
+        assert blocks["hvd_kv_blocks_used"] == 0
+        assert blocks["hvd_tokens_generated_total"] >= 4
+        assert ("hvd_rejected_total",
+                (("engine", "generate"), ("reason", "slots_full"))) in parsed
+        assert any(k[0] == "hvd_build_info" for k in parsed)
+
+    def test_http_metrics_merged(self, predict_engine, gen_engine):
+        with serve.HttpServer(engine=predict_engine,
+                              generate=gen_engine) as srv:
+            req = urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/metrics")
+            assert req.headers["Content-Type"].startswith("text/plain")
+            body = req.read().decode()
+        assert body.count("# TYPE hvd_requests_total counter") == 1
+        parsed = parse_exposition(body)
+        assert ("hvd_requests_total", (("engine", "predict"),)) in parsed
+        assert ("hvd_requests_total", (("engine", "generate"),)) in parsed
+        assert any(k[0] == "hvd_kv_blocks_free" for k in parsed)
+
+    def test_stats_uptime_and_version(self, gen_engine):
+        snap = gen_engine.stats()
+        assert snap["uptime_seconds"] > 0
+        assert snap["horovod_tpu_version"] == hvd.__version__
+        # json-ready stays json-ready
+        json.dumps(snap)
+
+    def test_reservoir_snapshot_locks_against_appends(self):
+        """The /stats percentile read takes the reservoir lock — hammer
+        add() from threads while reading quantiles; a torn list read
+        would raise (IndexError under list resize) or return garbage."""
+        from horovod_tpu.serve.metrics import _Reservoir
+        res = _Reservoir(capacity=64)
+        stop = threading.Event()
+
+        def _writer():
+            i = 0
+            while not stop.is_set():
+                res.add(float(i % 100))
+                i += 1
+
+        threads = [threading.Thread(target=_writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                q = res.quantile(0.99)
+                assert q is None or 0 <= q <= 99
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+
+# ---------------------------------------------------------------------------
+# Fleet summary (tpurun --metrics-summary)
+# ---------------------------------------------------------------------------
+
+class TestFleetSummary:
+    def test_fleet_line_aggregates(self):
+        listeners = []
+        try:
+            for r in range(2):
+                reg = MetricsRegistry()
+                reg.counter("hvd_steps_total", "s").inc(10 + r)
+                reg.counter("hvd_samples_total", "s").inc(160)
+                reg.counter("hvd_bad_steps_total", "b").inc(r)
+                reg.gauge("hvd_global_step", "g").set(10 + r)
+                listeners.append(MetricsListener(
+                    render=lambda reg=reg, r=r: reg.render(
+                        {"rank": str(r)})))
+            # Non-contiguous real ports: point the poller at each rank's
+            # actual listener via a port map shim.
+            from horovod_tpu.obs import summary as summ
+            fp = FleetPoller("127.0.0.1", 0, 2)
+            fp.sample = lambda: [summ.scrape("127.0.0.1", l.port)
+                                 for l in listeners]
+            line1 = fp.line()
+            assert "2/2 ranks up" in line1
+            assert "step 10..11" in line1
+            assert "bad_steps 1" in line1
+            line2 = fp.line()
+            assert "steps/s" in line2 and "samples/s" in line2
+        finally:
+            for l in listeners:
+                l.stop()
+
+    def test_dead_fleet(self):
+        fp = FleetPoller("127.0.0.1", 1, 2, timeout=0.2)
+        assert fp.line().startswith("fleet: 0/2 ranks up")
+
+    def test_one_shot_cli(self, monkeypatch):
+        from horovod_tpu.launcher import main
+        monkeypatch.delenv("HVD_METRICS_PORT", raising=False)
+        # No port anywhere -> explains itself and exits 2.
+        assert main(["-np", "2", "--metrics-summary"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Timeline crash-flush satellite
+# ---------------------------------------------------------------------------
+
+class TestTimelineCrashFlush:
+    def test_abort_flushes_to_disk(self, tmp_path):
+        from horovod_tpu.utils.timeline import Timeline
+        path = tmp_path / "tl.json"
+        tl = Timeline(str(path))
+        tl.start("serve", "INFERENCE")
+        tl.activity_start("serve", "QUEUE")
+        tl.abort("serve", error="killed")
+        # The tail is on disk BEFORE close — a SIGKILL after abort still
+        # leaves the trace (pre-PR the buffered tail died with the rank).
+        on_disk = path.read_text()
+        assert "INFERENCE" in on_disk and "killed" in on_disk
+        tl.close()
+
+    def test_flush_method_durable(self, tmp_path):
+        from horovod_tpu.utils.timeline import Timeline
+        path = tmp_path / "tl.json"
+        tl = Timeline(str(path))
+        tl.start("row", "OP")
+        assert "OP" not in path.read_text()   # still buffered
+        tl.flush()
+        assert "OP" in path.read_text()
+        tl.close()
+        assert path.read_text().rstrip().endswith("]")
+        tl.close()  # idempotent
+
+    def test_atexit_close_registered(self, tmp_path):
+        import atexit
+        from horovod_tpu.utils import timeline as tl_mod
+        registered = []
+        orig = atexit.register
+        try:
+            atexit.register = lambda fn, *a, **k: registered.append(fn)
+            tl = tl_mod.Timeline(str(tmp_path / "t.json"))
+        finally:
+            atexit.register = orig
+        assert tl.close in registered
+        tl.close()
