@@ -34,6 +34,17 @@ paged: pool = budget ÷ block bytes, slots = what the pool can hold of
 typical requests) — the concurrent-streams-capacity comparison at equal
 cache bytes.
 
+``--replicas N`` serves the generate load through a ``FleetRouter`` of
+N engine replicas (least-depth dispatch, one front door); adding
+``--autoscale`` starts at ``--min-replicas`` and lets the queue-depth
+``FleetAutoscaler`` grow toward N under load and drain-shrink back when
+traffic stops. Fleet runs append the per-point rows PLUS one final
+``{"fleet": true, ...}`` summary line (scale events, final membership,
+dispatch split, lost streams) — the ci.sh closed-loop autoscaler drill
+asserts grow >= 1, shrink back to the floor, zero lost streams, and a
+``stream_digest`` identical to the single-replica run of the same
+seeded traffic.
+
 Exit status is nonzero if any *in-deadline* request was dropped at the
 configured operating point — the regression gate ci.sh's serve smokes
 rely on (the generate smoke additionally requires nonzero tokens/sec).
@@ -176,6 +187,30 @@ def _build_gen_engine(args):
                            * _GEN_BYTES_PER_TOKEN)
         else:
             cache_bytes = slots * args.max_len * _GEN_BYTES_PER_TOKEN
+    if args.replicas > 1 or args.autoscale:
+        # Fleet mode: N replicas (each its own slots/block pool over the
+        # SHARED read-only params) behind one FleetRouter. --autoscale
+        # starts at --min-replicas and lets the queue-depth control loop
+        # grow toward --replicas; static fleets warm all N up front.
+        factory = lambda name: serve.GenerationEngine(  # noqa: E731
+            params, cfg, gcfg)
+        initial = args.min_replicas if args.autoscale else args.replicas
+        eng = serve.FleetRouter(factory=factory, initial=initial)
+        eng.bench_cache_bytes = cache_bytes    # per REPLICA (pool grows
+        t0 = time.monotonic()                  # with the fleet)
+        warmed = eng.warmup()
+        print(f"warmup [{args.kv_layout}, fleet {len(warmed)} replica(s) "
+              f"x slots={slots}]: pre-compiled in "
+              f"{time.monotonic() - t0:.2f} s")
+        if args.autoscale:
+            eng.bench_autoscaler = serve.FleetAutoscaler(
+                eng, min_replicas=args.min_replicas,
+                max_replicas=args.replicas,
+                high_watermark=args.scale_high,
+                low_watermark=args.scale_low,
+                breach_up=2, breach_down=2,
+                cooldown_s=1.0, interval_s=0.25).start()
+        return eng
     eng = serve.GenerationEngine(params, cfg, gcfg)
     eng.bench_cache_bytes = cache_bytes      # stamped into the JSON rows
     t0 = time.monotonic()
@@ -265,9 +300,16 @@ def run_gen_point(eng, qps: float, duration: float,
         "prefix_hit_blocks_total": gen["prefix_hit_blocks_total"],
         "stream_digest": digest,
     }
-    if snap["kv_layout"] == "paged":
+    if snap["kv_layout"] == "paged" and "block_size" in snap:
         row["block_size"] = snap["block_size"]
-        row["blocks"] = snap["blocks"]
+        row["blocks"] = snap.get("blocks")
+    if "fleet" in snap:
+        # Fleet rows: membership and the autoscaler's decisions AT ROW
+        # END (cumulative), so a spike row shows the grow it caused.
+        row["replicas_ready"] = snap["fleet"]["n_ready"]
+        row["replicas"] = snap["fleet"]["replicas"]
+        row["scale_events"] = snap["fleet"]["scale_events"]
+        row["dispatch"] = snap["fleet"]["dispatch_total"]
     return row
 
 
@@ -376,6 +418,22 @@ def main():
                    help="[generate] fixed system-prompt tokens prepended "
                         "to every request (the prefix-reuse traffic "
                         "shape)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="[generate] engine replicas behind one "
+                        "FleetRouter (static fleet; with --autoscale "
+                        "this is the GROW CEILING instead)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="[generate] start at --min-replicas and let the "
+                        "queue-depth FleetAutoscaler grow/shrink the "
+                        "fleet between --min-replicas and --replicas "
+                        "(docs/inference.md 'Serving fleet')")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="[generate, --autoscale] fleet floor")
+    p.add_argument("--scale-high", type=float, default=4.0,
+                   help="[generate, --autoscale] grow watermark: queued "
+                        "work per ready replica")
+    p.add_argument("--scale-low", type=float, default=0.5,
+                   help="[generate, --autoscale] shrink watermark")
     p.add_argument("--cache-mb", type=float, default=0,
                    help="[generate] fixed KV-cache byte budget; derives "
                         "slots (contiguous) or pool+slots (paged) — the "
@@ -387,6 +445,13 @@ def main():
     args = p.parse_args()
     if args.deadline_ms == 0:
         args.deadline_ms = None
+    if args.replicas < 1:
+        p.error("--replicas must be >= 1")
+    if args.min_replicas < 1:
+        p.error("--min-replicas must be >= 1 (a fleet of zero serves "
+                "nothing)")
+    if args.autoscale and args.min_replicas > args.replicas:
+        p.error("--min-replicas must be <= --replicas (the grow ceiling)")
 
     if args.mode == "generate":
         run_generate(args)
@@ -422,10 +487,42 @@ def main():
     print("SERVE BENCH OK")
 
 
+def _fleet_settle(eng, args, lost_streams: int):
+    """The closed loop's back half: traffic has stopped, so the
+    autoscaler must DRAIN the extra replicas (finishing every admitted
+    stream) and shrink back to the floor. Waits for the membership to
+    settle, then returns the fleet summary row the ci.sh drill asserts
+    on (grow >= 1, shrink to min, zero lost streams)."""
+    scaler = getattr(eng, "bench_autoscaler", None)
+    if scaler is not None:      # a static fleet has nothing to shrink
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            c = eng.counts()
+            if (c["ready"] <= args.min_replicas and c["warming"] == 0
+                    and c["draining"] == 0):
+                break
+            time.sleep(0.25)
+        scaler.stop()
+    snap = eng.stats()
+    return {
+        "fleet": True,
+        "autoscale": bool(args.autoscale),
+        "min_replicas": args.min_replicas,
+        "max_replicas": args.replicas,
+        "ready_final": snap["fleet"]["n_ready"],
+        "draining_final": snap["fleet"]["n_draining"],
+        "queue_depth_final": snap["queue_depth"],
+        "scale_events": snap["fleet"]["scale_events"],
+        "dispatch": snap["fleet"]["dispatch_total"],
+        "drained_lost_streams": lost_streams,
+    }
+
+
 def run_generate(args):
     import json
 
     eng = _build_gen_engine(args)
+    fleet = hasattr(eng, "counts")      # FleetRouter duck-type marker
     rng = np.random.RandomState(0)
     points = [float(q) for q in str(args.qps).split(",")]
     hdr = (f"{'qps→':>8}{'done':>7}{'ttft p50':>10}{'ttft p99':>10}"
@@ -433,10 +530,12 @@ def run_generate(args):
            f"{'deadline':>10}")
     print(hdr)
     dropped_in_deadline = 0
+    failed_total = 0
     total_tps = 0.0
     for q in points:
         row = run_gen_point(eng, q, args.duration, rng, args)
         dropped_in_deadline += row["overload_drops"] + row["failed"]
+        failed_total += row["failed"]
         total_tps += row["tokens_per_sec"]
         print(f"{row['qps_target']:>8.0f}{row['completed']:>7}"
               f"{row['ttft_p50_ms']:>10.2f}{row['ttft_p99_ms']:>10.2f}"
@@ -452,6 +551,12 @@ def run_generate(args):
             print("FAIL: empty TTFT report (no request completed)")
             eng.shutdown(drain=False)
             sys.exit(1)
+    if fleet:
+        fleet_row = _fleet_settle(eng, args, failed_total)
+        print(json.dumps(fleet_row))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(fleet_row) + "\n")
     eng.shutdown()
     if dropped_in_deadline:
         print(f"FAIL: {dropped_in_deadline} in-deadline requests dropped")

@@ -116,6 +116,46 @@ print(f"prefix reuse: {on['prefix_hits_total']} hits, digests identical")
 print("PREFIX REUSE OK")
 PYEOF
 
+echo "== serving fleet: closed-loop autoscaler drill (spike -> grow -> drain -> shrink) =="
+# ISSUE 13 acceptance: a traffic spike one replica cannot absorb must
+# (a) fire >= 1 grow scale-event and recover queue depth to 0, then
+# once traffic stops (b) drain the extra replicas losing ZERO admitted
+# streams and shrink back to min replicas — and the fleet's
+# completion-order-free stream digest must be IDENTICAL to a
+# single-replica run of the same seeded traffic (drain/dispatch may
+# move streams between replicas, never change a token).
+rm -f /tmp/hvd_fleet_ref.json /tmp/hvd_fleet_auto.json
+run_cpu timeout -k 10 300 python bin/serve_bench.py --mode generate \
+  --qps 150 --duration 6 --deadline-ms 0 --slots 1 --gen-tokens 32 \
+  --max-queue 2000 --json /tmp/hvd_fleet_ref.json
+run_cpu timeout -k 10 300 python bin/serve_bench.py --mode generate \
+  --qps 150 --duration 6 --deadline-ms 0 --slots 1 --gen-tokens 32 \
+  --max-queue 2000 --replicas 3 --autoscale --json /tmp/hvd_fleet_auto.json
+python - <<'PYEOF'
+import json
+auto_lines = [json.loads(l) for l in open("/tmp/hvd_fleet_auto.json")]
+row = [l for l in auto_lines if "stream_digest" in l][-1]
+fleet = [l for l in auto_lines if l.get("fleet")][-1]
+ref = [json.loads(l) for l in open("/tmp/hvd_fleet_ref.json")
+       if "stream_digest" in l][-1]
+assert row["completed"] == row["sent"], (row["completed"], row["sent"])
+assert row["overload_drops"] == 0 and row["failed"] == 0, row
+assert fleet["scale_events"]["grow"] >= 1, \
+    f"spike never grew the fleet: {fleet['scale_events']}"
+assert fleet["queue_depth_final"] == 0, \
+    f"queue depth never recovered: {fleet['queue_depth_final']}"
+assert fleet["ready_final"] == fleet["min_replicas"] == 1, \
+    f"fleet did not shrink back to min: {fleet}"
+assert fleet["drained_lost_streams"] == 0, fleet
+assert row["stream_digest"] == ref["stream_digest"], \
+    "fleet dispatch/drain changed a token stream"
+print(f"autoscaler closed loop OK: grow x{fleet['scale_events']['grow']}"
+      f" -> depth 0 -> shrink x{fleet['scale_events']['shrink']} to "
+      f"{fleet['ready_final']} replica(s), {row['completed']} streams, "
+      f"0 lost, digest == single-replica run")
+print("FLEET AUTOSCALER OK")
+PYEOF
+
 echo "== striped host reduce (multi-core validation, gated on nproc) =="
 if [ "$(nproc)" -gt 1 ]; then
   # On a >=4-core host, striping must not LOSE to the serial reduce at
@@ -145,6 +185,16 @@ fi
 
 echo "== tpurun launcher smoke (2 ranks, env-world) =="
 python -m horovod_tpu.launcher -np 2 --cpu python tests/launcher_worker.py
+
+# Flight-recorder hygiene for every chaos leg below: dumps default to
+# the cwd, so a previous run's hvd_flightrec.rank*.json in the repo root
+# could satisfy a pinned grep/assert from THIS run's leg (and stale
+# dumps mask real post-mortems). Clean them, then point the default dump
+# dir at a tmp dir — legs that pin dump CONTENTS still set their own
+# HVD_FLIGHTREC_DIR inline, which overrides the export.
+rm -f hvd_flightrec.rank*.json
+HVD_FLIGHTREC_DIR="$(mktemp -d)"
+export HVD_FLIGHTREC_DIR
 
 echo "== fault-injection smoke: kill rank 2 at step 3, recover via --restarts 1 =="
 # The anti-hang drill (docs/fault_tolerance.md): rank 2 is SIGKILLed mid

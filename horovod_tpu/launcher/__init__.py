@@ -709,7 +709,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "supervising. WITHOUT a command: one shot "
                              "against an already-running job's ranks, "
                              "then exit (needs -np + --metrics-port or "
-                             "HVD_METRICS_PORT)")
+                             "HVD_METRICS_PORT). Pointed at a serving "
+                             "fleet's /metrics port (-np 1), prints the "
+                             "replica-centric fleet line instead")
     parser.add_argument("--metrics-interval", type=float, default=10.0,
                         help="seconds between fleet lines under "
                              "--metrics-summary (default 10)")
@@ -729,7 +731,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         line = fleet.line()
         print(f"tpurun: {line}", flush=True)
-        return 0 if not line.startswith("fleet: 0/") else 1
+        # Structured verdict, not prose-parsing: exit 1 only when NO
+        # training rank answered. A serving-fleet scrape that answered
+        # is a live endpoint whatever its replica count says — exit 0.
+        return 0 if (fleet.last_mode == "serving"
+                     or fleet.last_up > 0) else 1
     if not args.command:
         parser.error("no command given")
     if args.nnodes > 1 and not args.coordinator:

@@ -287,6 +287,20 @@ class _Metric:
                                                       **self._kw))
         return child
 
+    def remove(self, **labels) -> None:
+        """Drop one child series (idempotent). For label sets that churn
+        over a process lifetime — e.g. a serving fleet's retired replica
+        names — unbounded children are a slow leak in memory AND in the
+        exposition; scrapers treat the disappearance as a normal series
+        termination."""
+        if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.label_names}, "
+                f"got {tuple(labels)}")
+        key = tuple(str(labels[k]) for k in self.label_names)
+        with self._lock:
+            self._children.pop(key, None)
+
     # Unlabeled convenience: the family IS its single child.
     def _only(self) -> _Child:
         if self.label_names:

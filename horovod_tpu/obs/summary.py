@@ -13,6 +13,14 @@ registry carries its own ``rank`` const label):
 * ``hvd_global_step`` reports min/max — a spread is a straggler;
 * everything is cumulative, so the poller keeps the previous sample and
   prints rates (steps/s, samples/s, tokens/s) from the delta.
+
+The poller also speaks *serving*: pointed at a
+:class:`~horovod_tpu.serve.router.FleetRouter`'s ``/metrics`` (``tpurun
+-np 1 --metrics-summary --metrics-port <serving port>``), the scrape
+carries ``hvd_fleet_replicas`` and the line flips to the replica-centric
+summary — ``fleet: K/N replicas ready | depth=… | ttft_p50<=…ms`` —
+with the TTFT quantile estimated from the fleet-summed
+``hvd_generate_ttft_seconds`` histogram buckets.
 """
 
 from __future__ import annotations
@@ -25,18 +33,31 @@ from typing import Dict, List, Optional
 from .registry import parse_exposition
 
 
-def scrape(host: str, port: int, timeout: float = 2.0) -> Optional[Dict]:
-    """One rank's parsed ``/metrics`` (series-name → summed value), or
-    None when unreachable (a dead/not-yet-up rank is a datum, not an
-    error)."""
+def scrape_exposition(host: str, port: int,
+                      timeout: float = 2.0) -> Optional[Dict]:
+    """One endpoint's fully parsed ``/metrics``
+    (``{(name, sorted-label-items): value}``), or None when
+    unreachable. The label-preserving form — the serving-fleet summary
+    needs the ``state=`` / ``le=`` breakdowns that name-summing
+    destroys."""
     url = f"http://{host}:{port}/metrics"
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             text = resp.read().decode("utf-8", "replace")
     except (urllib.error.URLError, OSError, ValueError):
         return None
+    return parse_exposition(text)
+
+
+def scrape(host: str, port: int, timeout: float = 2.0) -> Optional[Dict]:
+    """One rank's parsed ``/metrics`` (series-name → summed value), or
+    None when unreachable (a dead/not-yet-up rank is a datum, not an
+    error)."""
+    parsed = scrape_exposition(host, port, timeout)
+    if parsed is None:
+        return None
     out: Dict[str, float] = {}
-    for (name, _labels), v in parse_exposition(text).items():
+    for (name, _labels), v in parsed.items():
         out[name] = out.get(name, 0.0) + v
     return out
 
@@ -60,6 +81,14 @@ class FleetPoller:
         self._ranks = None if ranks is None else list(ranks)
         self._prev: Optional[Dict[str, float]] = None
         self._prev_t: Optional[float] = None
+        # The labeled parses behind the last sample() — kept so the
+        # serving-mode line reuses ONE scrape per poll instead of
+        # re-fetching every endpoint (None when sample() was shimmed).
+        self._last_labeled: Optional[List[Optional[Dict]]] = None
+        # Structured verdict of the last line() — what the one-shot CLI
+        # keys its exit code on (never parse the prose back).
+        self.last_mode: Optional[str] = None      # "training"|"serving"
+        self.last_up: int = 0                     # endpoints that answered
 
     def set_world(self, world: int) -> None:
         """Live resize moved the world size; later polls scrape the new
@@ -72,8 +101,81 @@ class FleetPoller:
         return [r for r in self._ranks if r < self.world]
 
     def sample(self) -> List[Optional[Dict]]:
-        return [scrape(self.host, self.base_port + r, self.timeout)
-                for r in self.ranks()]
+        self._last_labeled = [
+            scrape_exposition(self.host, self.base_port + r, self.timeout)
+            for r in self.ranks()]
+        out: List[Optional[Dict]] = []
+        for parsed in self._last_labeled:
+            if parsed is None:
+                out.append(None)
+                continue
+            summed: Dict[str, float] = {}
+            for (name, _labels), v in parsed.items():
+                summed[name] = summed.get(name, 0.0) + v
+            out.append(summed)
+        return out
+
+    def _serving_line(self, now: float, totals: Dict[str, float]) -> str:
+        """The serving-fleet flavor of :meth:`line`: a scrape that
+        carries ``hvd_fleet_replicas`` is a :class:`~horovod_tpu.serve.
+        router.FleetRouter` endpoint, not a training rank — summarize
+        replicas/depth/TTFT instead of steps. TTFT p50 comes from the
+        fleet-summed ``hvd_generate_ttft_seconds`` histogram (cumulative
+        bucket counts sum across replicas, so the quantile estimate is
+        fleet-wide — the thing per-replica reservoirs can never give).
+        Reuses the labeled parses the triggering :meth:`sample` already
+        fetched — one scrape per endpoint per poll (the fallback
+        re-fetch only fires when sample() was replaced by a shim)."""
+        labeled = self._last_labeled
+        if labeled is None:
+            labeled = [scrape_exposition(self.host, self.base_port + r,
+                                         self.timeout)
+                       for r in self.ranks()]
+        merged: Dict = {}
+        for parsed in labeled:
+            for key, v in (parsed or {}).items():
+                merged[key] = merged.get(key, 0.0) + v
+        states = {dict(labels).get("state"): v
+                  for (name, labels), v in merged.items()
+                  if name == "hvd_fleet_replicas"}
+        ready = int(states.get("ready", 0))
+        total = ready + int(states.get("warming", 0)) \
+            + int(states.get("draining", 0))
+        depth = sum(v for (name, _), v in merged.items()
+                    if name == "hvd_queue_depth")
+        parts = [f"fleet: {ready}/{total} replicas ready",
+                 f"depth={int(depth)}"]
+        buckets: Dict[str, float] = {}
+        for (name, labels), v in merged.items():
+            if name == "hvd_generate_ttft_seconds_bucket":
+                le = dict(labels).get("le", "+Inf")
+                buckets[le] = buckets.get(le, 0.0) + v
+        n = buckets.get("+Inf", 0.0)
+        if n > 0:
+            bounds = sorted((float(le), c) for le, c in buckets.items()
+                            if le != "+Inf")
+            p50 = next((b for b, c in bounds if c >= n / 2.0), None)
+            parts.append("ttft_p50<={:.1f}ms".format(p50 * 1e3)
+                         if p50 is not None else "ttft_p50>last_bucket")
+        else:
+            parts.append("ttft_p50=n/a")
+        for direction in ("grow", "shrink"):
+            key = ("hvd_fleet_scale_events_total",
+                   (("direction", direction),))
+            if key in merged:
+                parts.append(f"{direction}_events {int(merged[key])}")
+        # `totals` is line()'s name-summed view of the SAME scrape —
+        # rebuilt nowhere (three drifting copies of the summing loop is
+        # how a future series fix misses one).
+        if self._prev is not None and self._prev_t is not None:
+            dt = max(1e-9, now - self._prev_t)
+            if "hvd_tokens_generated_total" in totals:
+                rate = (totals["hvd_tokens_generated_total"]
+                        - self._prev.get("hvd_tokens_generated_total",
+                                         0.0)) / dt
+                parts.append(f"tokens/s {max(0.0, rate):.1f}")
+        self._prev, self._prev_t = totals, now
+        return " | ".join(parts)
 
     def line(self) -> str:
         samples = self.sample()
@@ -83,6 +185,13 @@ class FleetPoller:
         for s in up:
             for k, v in s.items():
                 totals[k] = totals.get(k, 0.0) + v
+        self.last_up = len(up)
+        self.last_mode = ("serving" if "hvd_fleet_replicas" in totals
+                          else "training")
+        if self.last_mode == "serving":
+            # The scraped port is a serving fleet's /metrics, not a
+            # training rank's — switch to the replica-centric summary.
+            return self._serving_line(now, totals)
         steps = [s.get("hvd_global_step") for s in up
                  if s.get("hvd_global_step") is not None]
         n_polled = len(samples)

@@ -29,6 +29,18 @@ prefix sharing (``GenerationConfig(kv_layout="paged", ...)``; see
     gen.warmup()
     for tok in gen.submit(prompt_ids, max_new_tokens=64):
         ...
+
+N engine replicas serve behind ONE front door as a *fleet*
+(:class:`~.router.FleetRouter`: least-queue-depth dispatch, warming
+replicas take no traffic, drain-on-evict loses no admitted stream) with
+a queue-depth autoscaler closing the loop
+(:class:`~.fleet.FleetAutoscaler`; docs/inference.md "Serving fleet"):
+
+    router = serve.FleetRouter(factory=lambda name: make_engine(),
+                               initial=2)
+    router.warmup()
+    serve.FleetAutoscaler(router, min_replicas=2, max_replicas=8).start()
+    serve.HttpServer(generate=router).start()
 """
 
 from .batcher import (  # noqa: F401
@@ -46,7 +58,9 @@ from .generate import (  # noqa: F401
     SamplingParams,
     prefill_buckets,
 )
-from .metrics import ServeMetrics  # noqa: F401
+from .metrics import FleetMetrics, ServeMetrics  # noqa: F401
+from .router import FleetRouter, ReplicaHandle  # noqa: F401
+from .fleet import FleetAutoscaler, heartbeat_liveness  # noqa: F401
 from .server import HttpServer  # noqa: F401
 from ..parallel.checkpoint import (  # noqa: F401
     INFERENCE_DTYPES,

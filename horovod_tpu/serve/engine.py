@@ -96,6 +96,21 @@ class ReadinessMixin:
             return False, "warming", len(self._queue)
         return True, "ok", len(self._queue)
 
+    def load(self) -> int:
+        """Dispatch pressure for a fleet router: queued requests plus
+        rows currently mid-execution (:meth:`_active_rows`) — the same
+        numbers this engine's ``/metrics`` exports as
+        ``hvd_queue_depth`` and ``hvd_active_slots``, so least-depth
+        routing and the operator's dashboard read one signal."""
+        return len(self._queue) + self._active_rows()
+
+    def _active_rows(self) -> int:
+        """Rows mid-execution. 0 for the single-shot engine (a batch is
+        in flight for milliseconds); the generation engine overrides
+        with its live decode slots — a stream occupies its slot for its
+        whole lifetime, which is real dispatch pressure."""
+        return 0
+
 
 class Engine(ReadinessMixin):
     """In-process dynamic-batching inference server.

@@ -553,6 +553,14 @@ class GenerationEngine(ReadinessMixin):
         """Synchronous :meth:`submit` (+ ``handle.result(timeout)``)."""
         return self.submit(tokens, **kw).result(timeout)
 
+    def _active_rows(self) -> int:
+        """Live decode slots plus block-starved held requests — with the
+        queue depth (:meth:`~.engine.ReadinessMixin.load`), the
+        fleet router's least-depth dispatch signal. Lock-free reads:
+        approximate by design (it orders replicas, it gates nothing)."""
+        return (sum(r is not None for r in self._slots)
+                + len(self._held))
+
     def stats(self) -> Dict:
         """The ``/stats`` snapshot (augments :class:`ServeMetrics` with
         the slot/compile view; ``batch_fill_ratio`` here is decode-slot

@@ -210,6 +210,12 @@ class ServeMetrics:
             self.prefix_hit_blocks_total += hit_blocks
             self.prefix_lookup_blocks_total += prompt_blocks
 
+    def ttft_totals(self) -> Tuple[float, int]:
+        """Cumulative ``(seconds_sum, count)`` of the TTFT histogram —
+        the rate()-able pair the fleet autoscaler differences between
+        polls (what a scraper's ``rate(_sum)/rate(_count)`` computes)."""
+        return self._h_ttft.sum, self._h_ttft.count
+
     def on_generation_end(self, n_tokens: int, seconds: float) -> None:
         """One finished request: records its tokens/sec-per-user (first
         token → last token — the per-stream decode rate, not aggregate
@@ -351,6 +357,102 @@ _BLOCKS = {
                                  "gauge",
                                  "Blocks pinned by the prefix registry"),
 }
+
+
+class FleetMetrics:
+    """The fleet plane's own series (a PRIVATE registry, same rule as
+    the engines: two metric surfaces in one process must not collide).
+    Three series, all under the stable-name contract of
+    ``docs/observability.md``:
+
+    * ``hvd_fleet_replicas{state=}`` — membership by state
+      (``ready`` / ``warming`` / ``draining`` / ``dead``), the gauge a
+      dashboard draws the fleet's size from;
+    * ``hvd_fleet_dispatch_total{replica=}`` — requests routed to each
+      replica (least-depth dispatch should keep these roughly level —
+      a skewed split means a sick replica);
+    * ``hvd_fleet_scale_events_total{direction=}`` — autoscaler
+      decisions committed (``grow`` / ``shrink``), pre-seeded at 0 so
+      "no event yet" is a scrapeable fact, not a missing series.
+    """
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self._g_replicas = self.registry.gauge(
+            "hvd_fleet_replicas", "Fleet membership by replica state",
+            labels=("state",))
+        self._c_dispatch = self.registry.counter(
+            "hvd_fleet_dispatch_total",
+            "Requests dispatched to each replica", labels=("replica",))
+        self._c_scale = self.registry.counter(
+            "hvd_fleet_scale_events_total",
+            "Autoscaler membership changes committed",
+            labels=("direction",))
+        for direction in ("grow", "shrink"):
+            self._c_scale.labels(direction=direction)
+        self._replica_names: List[str] = []
+        self._retired_names: set = set()
+        # One lock over the dispatch-fold composite: read-value + remove
+        # + re-inc in forget_replica must not interleave with an
+        # on_dispatch racing a drain decision, or the raced increment is
+        # dropped and the fleet dispatch total goes BACKWARDS.
+        self._fold_lock = threading.Lock()
+
+    def on_dispatch(self, replica: str) -> None:
+        with self._fold_lock:
+            if replica in self._retired_names:
+                # The dispatch raced an eviction (submit succeeded just
+                # before the replica was retired): the request WAS
+                # served there — credit the retired aggregate rather
+                # than resurrecting the folded named series, which
+                # nothing would ever fold again.
+                replica = "retired"
+            if replica not in self._replica_names:
+                self._replica_names.append(replica)
+            self._c_dispatch.labels(replica=replica).inc()
+
+    def forget_replica(self, name: str) -> None:
+        """A replica left the membership: fold its dispatch count into
+        the one ``replica="retired"`` aggregate and drop its named
+        series. Replica names are never reused, so without this an
+        autoscaling fleet's grow/shrink cycles would accumulate dead
+        ``hvd_fleet_dispatch_total{replica=}`` children forever — the
+        fold keeps the fleet-total monotone while bounding cardinality
+        at live-replicas + 1."""
+        with self._fold_lock:
+            self._retired_names.add(name)
+            if name not in self._replica_names:
+                return
+            count = self._c_dispatch.labels(replica=name).value
+            self._c_dispatch.remove(replica=name)
+            self._replica_names.remove(name)
+            if count > 0:
+                if "retired" not in self._replica_names:
+                    self._replica_names.append("retired")
+                self._c_dispatch.labels(replica="retired").inc(count)
+
+    def on_scale(self, direction: str) -> None:
+        if direction not in ("grow", "shrink"):
+            raise ValueError(
+                f"scale direction must be 'grow' or 'shrink', got "
+                f"{direction!r}")
+        self._c_scale.labels(direction=direction).inc()
+
+    def set_replicas(self, counts: Dict[str, int]) -> None:
+        """Refresh the membership gauge — every known state is SET
+        (absent states to 0) so a shrink is visible as ready going
+        down, not as a stale sample."""
+        for state in ("ready", "warming", "draining", "dead"):
+            self._g_replicas.labels(state=state).set(counts.get(state, 0))
+
+    def dispatch_counts(self) -> Dict[str, int]:
+        with self._fold_lock:
+            return {name: int(self._c_dispatch.labels(replica=name).value)
+                    for name in self._replica_names}
+
+    def scale_counts(self) -> Dict[str, int]:
+        return {d: int(self._c_scale.labels(direction=d).value)
+                for d in ("grow", "shrink")}
 
 
 def collect_stats(snap: Dict, registry: MetricsRegistry,

@@ -1,0 +1,615 @@
+"""Replica membership and admission routing for a serving fleet.
+
+One :class:`~.generate.GenerationEngine` (or single-shot
+:class:`~.engine.Engine`) is a *replica*: one decode batch over its own
+slots and KV block pool. The ROADMAP's "millions of users" traffic does
+not fit one replica, and simply running N engines behind N ports pushes
+the load-balancing problem onto every client. :class:`FleetRouter` is
+the missing layer: ONE front door that owns admission for the whole
+fleet and fans requests out to N replicas.
+
+Design rules, each load-bearing:
+
+* **The router is the single admission point, not a second buffer.**
+  Every replica already owns a bounded admission queue with
+  overload-at-the-door semantics (PR 2); parking requests in a router
+  queue in front of those would strand them when their eventual replica
+  dies and would hide queue pressure from the autoscaler. Admission
+  happens once, at :meth:`FleetRouter.submit`: pick the least-loaded
+  READY replica, hand the request to its queue, and fail over to the
+  next replica if that door is shut. The fleet rejects only when EVERY
+  ready replica rejected — one saturated replica never bounces traffic
+  the rest could serve.
+* **Least-queue-depth dispatch reads the metrics the replicas already
+  export.** :meth:`ReadinessMixin.load` is the same number `/metrics`
+  publishes as ``hvd_queue_depth`` (+ active decode rows); no parallel
+  bookkeeping that could drift from what the operator's dashboard says.
+* **Readiness is the PR-4 ``/healthz`` contract, per replica.** A
+  ``warming`` replica (engine built, ``warmup()`` still compiling)
+  takes NO traffic — routing to it would make a user pay the compile. A
+  ``draining`` replica takes no NEW traffic but finishes every stream
+  already admitted — scale-down may never lose an admitted stream
+  (the bit-identity drill in tests/test_fleet.py and the ci.sh
+  autoscaler leg pin exactly this).
+* **Liveness is the existing ``coord/`` heartbeat plane, not a second
+  protocol.** Thread replicas are in-process: their loop thread is the
+  ground truth. Multi-process replicas form a coordinator world whose
+  heartbeat timeouts (PR 1) already detect silence; a
+  :class:`ReplicaHandle` wires ``liveness=`` to that plane
+  (:func:`~.fleet.heartbeat_liveness`) and the router EVICTS on its
+  verdict — it never grows its own poller.
+
+The router duck-types the engine surface (``submit`` / ``generate`` /
+``infer`` / ``stats`` / ``health`` / ``prom_collect`` / ``warmup`` /
+``shutdown``), so :class:`~.server.HttpServer` mounts a fleet exactly
+where it mounted one engine: ``POST /generate`` routes through the
+router, ``GET /metrics`` merges every replica's samples (each carrying
+a ``replica=`` label) with the fleet series into ONE valid exposition,
+``GET /healthz`` reports fleet readiness (>= 1 ready replica).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..exceptions import ServerClosedError, ServerOverloadedError
+from .metrics import FleetMetrics
+
+_log = logging.getLogger("horovod_tpu.serve.fleet")
+
+# Replica states, in dispatch-priority order of meaning:
+#   warming  — engine exists, warmup() not finished: takes NO traffic
+#   ready    — routable
+#   draining — scale-down in progress: finishes admitted streams, no new
+#   dead     — liveness said gone (heartbeat abort / loop thread died)
+REPLICA_STATES = ("ready", "warming", "draining", "dead")
+
+
+class ReplicaHandle:
+    """One fleet member: a name, an engine, and the membership verdicts
+    the router needs (state, load, liveness).
+
+    ``liveness`` is an optional zero-arg callable returning False once
+    the replica's backing process is gone — for multi-process replicas
+    this is the coord heartbeat plane
+    (:func:`~.fleet.heartbeat_liveness`); thread replicas default to
+    their engine loop thread's aliveness. The handle never invents its
+    own poller.
+    """
+
+    def __init__(self, name: str, engine: Any,
+                 liveness: Optional[Callable[[], bool]] = None):
+        self.name = name
+        self.engine = engine
+        self._liveness = liveness
+        self._draining = False
+        self._dead = False
+        self._drain_thread: Optional[threading.Thread] = None
+
+    def alive(self) -> bool:
+        if self._dead:
+            return False
+        if self._liveness is not None:
+            try:
+                return bool(self._liveness())
+            except Exception:  # noqa: BLE001 — a broken probe is "gone"
+                return False
+        # Thread replicas: the engine loop thread is the ground truth —
+        # it only exits on drain-complete or abort, both terminal.
+        thread = getattr(self.engine, "_thread", None)
+        if thread is not None and not thread.is_alive() \
+                and not getattr(self.engine, "_closed", False):
+            return False
+        return True
+
+    def state(self) -> str:
+        if not self.alive():
+            return "dead"
+        if self._draining or getattr(self.engine, "_closed", False):
+            return "draining"
+        ready, _, _ = self.engine.health()
+        return "ready" if ready else "warming"
+
+    def load(self) -> int:
+        """Dispatch pressure: queued + executing rows — the same number
+        this replica's ``/metrics`` exports (``hvd_queue_depth`` +
+        ``hvd_active_slots``)."""
+        try:
+            return int(self.engine.load())
+        except Exception:  # noqa: BLE001 — a dying replica reads as busy
+            return 1 << 30
+
+
+class FleetRouter:
+    """Admission router + replica membership for N serving engines.
+
+    Args:
+      engines: pre-built engines to wrap (replica names ``r0..rN-1``).
+      factory: ``factory(name) -> engine`` for membership changes —
+        required by :meth:`add_replica` (and therefore by the
+        :class:`~.fleet.FleetAutoscaler`).
+      initial: replicas to build from ``factory`` at construction.
+      liveness_factory: optional ``liveness_factory(name) -> callable``
+        wiring each new replica's liveness to the coord heartbeat plane
+        (multi-process fleets); thread replicas leave it None.
+      drain_timeout: seconds a drain-on-evict waits for the replica to
+        finish its admitted streams before the handle is force-reaped.
+    """
+
+    def __init__(self, engines: Optional[List[Any]] = None, *,
+                 factory: Optional[Callable[[str], Any]] = None,
+                 initial: int = 0,
+                 liveness_factory: Optional[Callable] = None,
+                 drain_timeout: float = 60.0):
+        self._factory = factory
+        self._liveness_factory = liveness_factory
+        self._drain_timeout = drain_timeout
+        self._lock = threading.Lock()
+        self._metrics = FleetMetrics()
+        self._replicas: List[ReplicaHandle] = []
+        self._seq = 0
+        self._closed = False
+        self._t0 = time.monotonic()
+        # Final counter totals of replicas that LEFT the membership:
+        # the fleet aggregates in stats() add these baselines so
+        # cumulative fields (requests_total, tokens_generated_total,
+        # prefix hits, rejections) never go BACKWARDS across a shrink —
+        # the same monotonicity rule FleetMetrics.forget_replica keeps
+        # for the dispatch counter.
+        self._retired_totals: Dict[str, float] = {}
+        self._retired_gen_totals: Dict[str, float] = {}
+        # Fleet-wide concurrency high-water, sampled at dispatch and
+        # stats boundaries. Summing per-replica peaks would add maxima
+        # that never coincided (and the sum would DROP when a replica
+        # retires) — a "peak" must be monotone and fleet-coincident.
+        self._peak_active = 0
+        for eng in engines or []:
+            self._attach(eng)
+        for _ in range(initial):
+            if factory is None:
+                raise ValueError(
+                    "FleetRouter(initial=N) needs a factory= to build "
+                    "replicas from")
+            name = self._next_name()
+            self._attach(factory(name), name=name)
+        self._refresh_gauges()
+
+    # -- membership --------------------------------------------------------
+
+    def _next_name(self) -> str:
+        name = f"r{self._seq}"
+        self._seq += 1
+        return name
+
+    def _attach(self, engine: Any, name: Optional[str] = None
+                ) -> ReplicaHandle:
+        with self._lock:
+            if name is None:
+                name = self._next_name()
+            liveness = (self._liveness_factory(name)
+                        if self._liveness_factory else None)
+            handle = ReplicaHandle(name, engine, liveness=liveness)
+            self._replicas.append(handle)
+        return handle
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas)
+
+    def counts(self) -> Dict[str, int]:
+        """Membership by state (``{"ready": ..., "warming": ...,
+        "draining": ..., "dead": ...}``)."""
+        out = {s: 0 for s in REPLICA_STATES}
+        for h in self.replicas():
+            out[h.state()] += 1
+        return out
+
+    def add_replica(self, warm: bool = True) -> ReplicaHandle:
+        """Grow the fleet by one replica. The engine is built
+        synchronously (cheap — allocations, no compiles); ``warmup()``
+        runs on a background thread, during which the replica reads
+        ``warming`` and takes no traffic. Scale-up is therefore
+        hitless: current replicas keep serving while the newcomer
+        compiles."""
+        if self._closed:
+            raise ServerClosedError("fleet router is shut down")
+        if self._factory is None:
+            raise RuntimeError(
+                "add_replica needs FleetRouter(factory=...) — the router "
+                "cannot build engines it was never taught to build")
+        with self._lock:
+            name = self._next_name()
+        handle = self._attach(self._factory(name), name=name)
+
+        def _warm():
+            try:
+                handle.engine.warmup()
+            except Exception as e:  # noqa: BLE001 — a failed warm = dead
+                _log.warning("replica %s failed warmup: %r", handle.name, e)
+                handle._dead = True
+            self._refresh_gauges()
+
+        if warm:
+            t = threading.Thread(target=_warm,
+                                 name=f"hvd-fleet-warm-{name}", daemon=True)
+            t.start()
+        self._refresh_gauges()
+        return handle
+
+    def remove_replica(self, name: Optional[str] = None) -> ReplicaHandle:
+        """Shrink the fleet by one replica, drain-on-evict: the replica
+        stops taking NEW traffic immediately, finishes every stream it
+        already admitted (the engine's ``shutdown(drain=True)``
+        contract), and only then leaves the membership — no admitted
+        stream is ever lost on scale-down. Returns the draining handle
+        (``handle._drain_thread.join()`` to wait)."""
+        with self._lock:
+            candidates = [h for h in self._replicas if not h._draining]
+            if name is not None:
+                candidates = [h for h in candidates if h.name == name]
+            if not candidates:
+                raise ValueError(
+                    f"no evictable replica"
+                    f"{' named ' + name if name else ''} "
+                    f"(states: {[ (h.name, h.state()) for h in self._replicas ]})")
+            # Prefer a READY replica with the least to drain; fall back
+            # to whatever is left (a warming replica drains instantly).
+            ready = [h for h in candidates if h.state() == "ready"]
+            pool = ready or candidates
+            handle = min(pool, key=lambda h: h.load())
+            handle._draining = True
+
+        def _drain():
+            try:
+                handle.engine.shutdown(drain=True,
+                                       timeout=self._drain_timeout)
+            except Exception as e:  # noqa: BLE001
+                _log.warning("replica %s drain raised: %r", handle.name, e)
+            self._retire(handle)
+            self._refresh_gauges()
+
+        t = threading.Thread(target=_drain,
+                             name=f"hvd-fleet-drain-{handle.name}",
+                             daemon=True)
+        handle._drain_thread = t
+        t.start()
+        self._refresh_gauges()
+        return handle
+
+    def poll(self) -> Dict[str, int]:
+        """One membership sweep (the autoscaler calls this every tick):
+        evict replicas whose liveness verdict says gone — a dead replica
+        cannot drain, so its streams fail fast instead of hanging their
+        clients — and refresh the ``hvd_fleet_replicas`` gauges.
+        Returns :meth:`counts` after the sweep."""
+        for h in self.replicas():
+            if h.state() == "dead":
+                self._evict_dead(h)
+        self._refresh_gauges()
+        return self.counts()
+
+    def _evict_dead(self, handle: ReplicaHandle) -> None:
+        _log.warning("replica %s is dead (liveness verdict) — evicting "
+                     "without drain", handle.name)
+        handle._dead = True
+        self._retire(handle)
+
+        def _reap():
+            try:
+                handle.engine.shutdown(drain=False, timeout=5.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+        threading.Thread(target=_reap, name=f"hvd-fleet-reap-{handle.name}",
+                         daemon=True).start()
+
+    def _retire(self, handle: ReplicaHandle) -> None:
+        """Remove ``handle`` from membership, folding its final counter
+        totals into the retired baselines so the fleet aggregates stay
+        monotone (best-effort for a dead replica whose stats raise).
+        Exactly-once: the fold happens only on the call that wins the
+        membership removal — a drain completing while a liveness
+        verdict evicts the same replica must not double-count its
+        history."""
+        snap: Dict[str, Any] = {}
+        try:
+            snap = handle.engine.stats()
+        except Exception:  # noqa: BLE001 — a dead replica keeps what it had
+            pass
+        with self._lock:
+            if handle not in self._replicas:
+                return
+            self._replicas.remove(handle)
+            for key in self._COUNTER_KEYS:
+                v = snap.get(key)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self._retired_totals[key] = (
+                        self._retired_totals.get(key, 0) + v)
+            for key in self._GEN_SUM_KEYS:
+                v = (snap.get("generation") or {}).get(key)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self._retired_gen_totals[key] = (
+                        self._retired_gen_totals.get(key, 0) + v)
+        self._metrics.forget_replica(handle.name)
+
+    def _note_peak(self) -> None:
+        """Sample the fleet's CURRENT total active streams into the
+        high-water mark (called at dispatch and stats boundaries —
+        approximate between samples; per-replica exact peaks stay in
+        the nested snapshots)."""
+        active = 0
+        for h in self.replicas():
+            try:
+                active += h.engine._active_rows()
+            except Exception:  # noqa: BLE001 — a dying replica counts 0
+                pass
+        with self._lock:
+            # Compare+assign under the lock: two dispatch threads racing
+            # the check-then-set could otherwise publish the SMALLER
+            # sample last and regress the high-water.
+            if active > self._peak_active:
+                self._peak_active = active
+
+    def _refresh_gauges(self) -> None:
+        self._metrics.set_replicas(self.counts())
+
+    # -- dispatch ----------------------------------------------------------
+
+    def submit(self, *args, **kwargs):
+        """Admit one request to the fleet: least-loaded READY replica
+        first, failing over across the ready set. Raises
+        :class:`ServerOverloadedError` only when EVERY ready replica
+        rejected (or none is ready yet — a warming fleet is a retryable
+        condition), :class:`ServerClosedError` once the router (or the
+        whole membership) is shut down. Returns whatever the replica's
+        ``submit`` returns (a :class:`~.generate.GenerationHandle` for
+        generation fleets, a ``Future`` for single-shot fleets)."""
+        if self._closed:
+            raise ServerClosedError("fleet router is shut down")
+        snapshot = self.replicas()
+        ready = sorted((h for h in snapshot if h.state() == "ready"),
+                       key=lambda h: h.load())
+        if not ready:
+            warming = sum(1 for h in snapshot if h.state() == "warming")
+            if warming:
+                raise ServerOverloadedError(
+                    f"no ready replicas yet ({warming} warming) — retry "
+                    f"after backoff")
+            if self._factory is not None:
+                # An open router with a factory is one autoscaler tick
+                # away from a below-min refill — a terminal "closed"
+                # here would tell well-behaved clients to stop retrying
+                # a fleet about to heal.
+                raise ServerOverloadedError(
+                    "no live replicas right now (the fleet can refill) "
+                    "— retry after backoff")
+            raise ServerClosedError(
+                "fleet has no live replicas (all drained or dead)")
+        last: Optional[BaseException] = None
+        for h in ready:
+            try:
+                out = h.engine.submit(*args, **kwargs)
+            except ServerOverloadedError as e:
+                last = e
+                continue
+            except ServerClosedError as e:
+                # Raced a drain decision between the snapshot and the
+                # submit: that replica's door is shut, not the fleet's.
+                last = e
+                continue
+            self._metrics.on_dispatch(h.name)
+            self._note_peak()
+            return out
+        raise ServerOverloadedError(
+            f"all {len(ready)} ready replicas rejected the request "
+            f"(last: {last}) — grow the fleet or shed load")
+
+    def generate(self, tokens, timeout: Optional[float] = None, **kw):
+        """Synchronous generation through the fleet (submit + result)."""
+        return self.submit(tokens, **kw).result(timeout)
+
+    def infer(self, inputs, deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None):
+        """Synchronous single-shot inference through a fleet of
+        :class:`~.engine.Engine` replicas (the ``/predict`` path)."""
+        return self.submit(inputs, deadline_ms=deadline_ms).result(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self) -> Tuple[str, ...]:
+        """Warm every current replica (sequentially — deploy-time code;
+        mid-run growth warms on its own thread via
+        :meth:`add_replica`). Returns the replica names warmed."""
+        warmed = []
+        for h in self.replicas():
+            if h.state() == "warming":
+                h.engine.warmup()
+            warmed.append(h.name)
+        self._refresh_gauges()
+        return tuple(warmed)
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop the fleet. ``drain=True`` finishes every admitted stream
+        on every replica (drained concurrently) first. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        handles = self.replicas()
+        threads = []
+        for h in handles:
+            t = threading.Thread(
+                target=lambda h=h: h.engine.shutdown(drain=drain,
+                                                     timeout=timeout),
+                name=f"hvd-fleet-stop-{h.name}", daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout)
+        for h in handles:
+            if h._drain_thread is not None:
+                h._drain_thread.join(timeout)
+        self._refresh_gauges()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- health / stats / metrics ------------------------------------------
+
+    def health(self) -> Tuple[bool, str, int]:
+        """Fleet-level ``/healthz``: ready iff >= 1 replica is ready.
+        Status mirrors the per-engine vocabulary (``ok`` / ``warming`` /
+        ``draining``) so load balancers need no new parser; the per-state
+        breakdown lives in :meth:`fleet_health`."""
+        c = self.counts()
+        # Exclude dead replicas from the depth sum: their load() reads
+        # as the 1<<30 dispatch-ordering sentinel, which would turn the
+        # /healthz queue_depth into a nonsense spike until the next
+        # membership sweep evicts them.
+        depth = sum(h.load() for h in self.replicas()
+                    if h.state() != "dead")
+        if self._closed:
+            return False, "draining", depth
+        if c["ready"] >= 1:
+            return True, "ok", depth
+        if c["warming"] >= 1:
+            return False, "warming", depth
+        return False, "draining", depth
+
+    def fleet_health(self) -> Dict[str, int]:
+        """Membership breakdown for the ``/healthz`` body."""
+        return self.counts()
+
+    def ttft_totals(self) -> Tuple[float, int]:
+        """Fleet-cumulative ``(ttft_seconds_sum, count)`` summed from
+        each replica's ``hvd_generate_ttft_seconds`` histogram — the
+        rate()-able pair the autoscaler differences between polls."""
+        s, n = 0.0, 0
+        for h in self.replicas():
+            m = getattr(h.engine, "_metrics", None)
+            if m is None or not hasattr(m, "ttft_totals"):
+                continue
+            ds, dn = m.ttft_totals()
+            s += ds
+            n += dn
+        return s, n
+
+    # /stats keys summed across replicas (the fleet-aggregate view the
+    # bench and dashboards read; per-replica truth nests under
+    # "replicas"). Percentile fields cannot be summed and are omitted —
+    # scrape the histograms for fleet quantiles. _COUNTER_KEYS are the
+    # CUMULATIVE subset: a retiring replica's final values fold into the
+    # retired baseline so they never go backwards across a shrink;
+    # gauges (queue depth, slots) reflect live membership only.
+    _COUNTER_KEYS = ("requests_total", "responses_total",
+                     "rejected_overload", "rejected_slots_full",
+                     "rejected_blocks_exhausted", "expired_deadline",
+                     "cancelled_shutdown", "batches_total",
+                     "batch_rows_total", "batch_live_rows_total")
+    # (peak_active_slots is NOT summed: the fleet peak is the router's
+    # own sampled high-water — see _note_peak.)
+    _GAUGE_KEYS = ("queue_depth", "active_slots", "max_slots")
+    _SUM_KEYS = _COUNTER_KEYS + _GAUGE_KEYS
+    _GEN_SUM_KEYS = ("generations_total", "tokens_generated_total",
+                     "prefix_hits_total", "prefix_misses_total",
+                     "prefix_hit_blocks_total", "prefix_lookup_blocks_total")
+
+    def stats(self) -> Dict:
+        """The fleet ``/stats`` snapshot: aggregate counters at the top
+        (same key names as one engine, so existing consumers keep
+        reading), per-replica snapshots under ``"replicas"``, and the
+        fleet plane (membership, dispatch, scale events) under
+        ``"fleet"``."""
+        self._note_peak()
+        per: Dict[str, Dict] = {}
+        states: Dict[str, str] = {}
+        for h in self.replicas():
+            try:
+                per[h.name] = h.engine.stats()
+            except Exception as e:  # noqa: BLE001 — a dying replica's
+                per[h.name] = {"error": repr(e)}   # stats must not 500 /stats
+            states[h.name] = h.state()
+        snap: Dict[str, Any] = {
+            "uptime_seconds": time.monotonic() - self._t0,
+            "kv_layout": None,
+            "max_len": None,
+        }
+        with self._lock:
+            retired = dict(self._retired_totals)
+            retired_gen = dict(self._retired_gen_totals)
+        for key in self._SUM_KEYS:
+            vals = [p.get(key) for p in per.values()
+                    if isinstance(p.get(key), (int, float))]
+            snap[key] = sum(vals) + retired.get(key, 0) if (
+                vals or key in retired) else 0
+        gen: Dict[str, Any] = {}
+        for key in self._GEN_SUM_KEYS:
+            vals = [p.get("generation", {}).get(key) for p in per.values()
+                    if isinstance(p.get("generation", {}).get(key),
+                                  (int, float))]
+            gen[key] = sum(vals) + retired_gen.get(key, 0)
+        snap["generation"] = gen
+        snap["peak_active_slots"] = self._peak_active
+        rows, live = snap.get("batch_rows_total", 0), snap.get(
+            "batch_live_rows_total", 0)
+        snap["batch_fill_ratio"] = (live / rows) if rows else None
+        for p in per.values():
+            if snap["kv_layout"] is None and "kv_layout" in p:
+                snap["kv_layout"] = p["kv_layout"]
+            if "max_len" in p:
+                snap["max_len"] = max(snap["max_len"] or 0, p["max_len"])
+        blocks = [p["blocks"] for p in per.values() if "blocks" in p]
+        if blocks and len(blocks) == len(per):
+            snap["blocks"] = {k: sum(b.get(k, 0) for b in blocks)
+                              for k in blocks[0]}
+            sizes = {p.get("block_size") for p in per.values()}
+            if len(sizes) == 1:
+                snap["block_size"] = sizes.pop()
+        hits, misses = gen.get("prefix_hits_total", 0), gen.get(
+            "prefix_misses_total", 0)
+        snap["prefix_hit_rate"] = (hits / (hits + misses)
+                                   if hits + misses else None)
+        snap["replicas"] = per
+        snap["fleet"] = {
+            "replicas": len(per),
+            "states": states,
+            **{f"n_{s}": n for s, n in self.counts().items()},
+            "dispatch_total": self._metrics.dispatch_counts(),
+            "scale_events": self._metrics.scale_counts(),
+        }
+        return snap
+
+    def prom_collect(self):
+        """The fleet's ``(meta, samples)``: every replica's samples with
+        a ``replica=`` label added, merged with the fleet-plane series
+        (``hvd_fleet_replicas{state=}``,
+        ``hvd_fleet_dispatch_total{replica=}``,
+        ``hvd_fleet_scale_events_total{direction=}``) — ONE render is
+        one valid exposition (grouped by metric name, single ``# TYPE``
+        each; the reason this cannot be string concatenation)."""
+        self._refresh_gauges()
+        meta: Dict = {}
+        samples: List = []
+        for h in self.replicas():
+            try:
+                m, s = h.engine.prom_collect()
+            except Exception:  # noqa: BLE001 — scrape what still answers
+                continue
+            meta.update(m)
+            samples.extend((name, {**labels, "replica": h.name}, v)
+                           for name, labels, v in s)
+        m, s = self._metrics.registry.collect()
+        meta.update(m)
+        samples.extend(s)
+        return meta, samples
+
+    def prom_metrics(self) -> str:
+        """Prometheus text exposition of :meth:`prom_collect` (the
+        fleet ``/metrics`` body)."""
+        from ..obs.registry import render
+        return render(*self.prom_collect())

@@ -28,6 +28,16 @@ status codes so clients see conventional semantics:
   depth otherwise — so a load balancer stops routing to a cold engine
   (first bucket hits pay a compile) or a dying one (new requests would
   race the drain)
+
+Either slot (``engine=`` / ``generate=``) also accepts a
+:class:`~.router.FleetRouter` — the router duck-types the engine
+surface, so mounting a replicated fleet changes nothing here:
+``POST /generate`` / ``POST /predict`` route through the router's
+least-depth dispatch, ``GET /stats`` nests per-replica snapshots under
+``"replicas"``, ``GET /metrics`` is ONE merged exposition whose
+per-replica samples carry a ``replica=`` label next to the fleet-plane
+series, and ``GET /healthz`` reports fleet-level readiness (>= 1 ready
+replica) with the membership breakdown in the body.
 """
 
 from __future__ import annotations
@@ -96,12 +106,23 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif path == "/healthz":
-            ready, status, depth = self._primary().health()
+            primary = self._primary()
+            ready, status, depth = primary.health()
             if ready and self.gen_engine is not None \
-                    and self.gen_engine is not self._primary():
+                    and self.gen_engine is not primary:
                 ready, status, depth = self.gen_engine.health()
-            self._reply(200 if ready else 503,
-                        {"status": status, "queue_depth": depth})
+            body = {"status": status, "queue_depth": depth}
+            # A mounted FleetRouter knows more than ok/warming/draining:
+            # include the membership breakdown so a probe (or operator
+            # curl) sees HOW ready the fleet is, not just whether. A
+            # router may sit in EITHER slot (e.g. a single-shot primary
+            # with a generation fleet) — ask both.
+            for eng in (primary, self.gen_engine):
+                fleet_health = getattr(eng, "fleet_health", None)
+                if callable(fleet_health):
+                    body["replicas"] = fleet_health()
+                    break
+            self._reply(200 if ready else 503, body)
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 

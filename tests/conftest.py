@@ -7,6 +7,18 @@ Must run before any jax backend initialization: forces the CPU platform with
 """
 
 import os
+import tempfile
+
+# Flight-recorder dumps (kill drills, abort post-mortems) default to the
+# cwd — a suite run from the repo root would litter it with stale
+# hvd_flightrec.rank*.json files that mask REAL post-mortems (and could
+# satisfy a later run's pinned asserts). Park them in a tmp dir unless
+# the caller pinned one.
+if "HVD_FLIGHTREC_DIR" not in os.environ:
+    # (Not setdefault: its default arg is evaluated eagerly, which would
+    # leak one orphan temp dir per run whenever the caller pinned a dir.)
+    os.environ["HVD_FLIGHTREC_DIR"] = tempfile.mkdtemp(
+        prefix="hvd_flightrec_")
 
 _flag = "--xla_force_host_platform_device_count=8"
 _existing = os.environ.get("XLA_FLAGS", "")
