@@ -34,6 +34,16 @@ paged: pool = budget ÷ block bytes, slots = what the pool can hold of
 typical requests) — the concurrent-streams-capacity comparison at equal
 cache bytes.
 
+``--adapters N`` serves multi-tenant traffic: N seeded LoRA fine-tunes
+(tenants ``a0..aN-1``) loaded next to the ``base`` model, arrivals drawn
+per ``--adapter-mix`` weights from per-tenant deterministic prompt
+streams. EVERY generate-mode JSON line then stamps the adapter fields
+(``adapters``, ``adapter_mix``, ``tenant_sent``/``tenant_completed``)
+and a per-tenant ``stream_digests`` map extending the PR-11 digest —
+``--adapter-only TENANT`` replays the SAME arrival schedule submitting
+only that tenant's requests, so ci.sh can pin each tenant's mixed-batch
+digest against its single-tenant reference run.
+
 ``--replicas N`` serves the generate load through a ``FleetRouter`` of
 N engine replicas (least-depth dispatch, one front door); adding
 ``--autoscale`` starts at ``--min-replicas`` and lets the queue-depth
@@ -158,6 +168,40 @@ def _build_engine(args):
     return eng
 
 
+def _bench_tenants(args):
+    """Tenant names + normalized arrival weights for this run:
+    ``base`` plus ``a0..aN-1`` (uniform unless ``--adapter-mix``)."""
+    tenants = ["base"] + [f"a{i}" for i in range(args.adapters)]
+    if args.adapter_mix:
+        weights = [float(w) for w in args.adapter_mix.split(",")]
+        if len(weights) != len(tenants) or any(w < 0 for w in weights) \
+                or not sum(weights) > 0:
+            raise SystemExit(
+                f"--adapter-mix needs {len(tenants)} non-negative "
+                f"comma-separated weights (base first, then "
+                f"{tenants[1:]}), got {args.adapter_mix!r}")
+    else:
+        weights = [1.0] * len(tenants)
+    total = sum(weights)
+    return tenants, [w / total for w in weights]
+
+
+def _bench_adapters(args, cfg):
+    """The run's LoRA plane: (lora_cfg, {name: host adapter tree}) —
+    seeded, B randomized so the M tenants are genuinely DISTINCT
+    fine-tunes (distinct streams, checkable digests)."""
+    if not args.adapters:
+        return None, None
+    import jax
+
+    from horovod_tpu.parallel.lora import LoraConfig, init_adapter
+    lora = LoraConfig(rank=args.adapter_rank)
+    trees = {f"a{i}": init_adapter(jax.random.PRNGKey(100 + i), cfg,
+                                   lora, b_scale=0.5)
+             for i in range(args.adapters)}
+    return lora, trees
+
+
 def _build_gen_engine(args):
     import jax
     import jax.numpy as jnp
@@ -187,15 +231,30 @@ def _build_gen_engine(args):
                            * _GEN_BYTES_PER_TOKEN)
         else:
             cache_bytes = slots * args.max_len * _GEN_BYTES_PER_TOKEN
+    lora, adapter_trees = _bench_adapters(args, cfg)
+
+    def _registry():
+        if not adapter_trees:
+            return None
+        reg = serve.AdapterRegistry(cfg, lora,
+                                    capacity=len(adapter_trees))
+        for name, tree in sorted(adapter_trees.items()):
+            reg.load(name, tree)
+        return reg
+
     if args.replicas > 1 or args.autoscale:
-        # Fleet mode: N replicas (each its own slots/block pool over the
-        # SHARED read-only params) behind one FleetRouter. --autoscale
-        # starts at --min-replicas and lets the queue-depth control loop
-        # grow toward --replicas; static fleets warm all N up front.
+        # Fleet mode: N replicas (each its own slots/block pool — and
+        # its own adapter table — over the SHARED read-only params)
+        # behind one FleetRouter. --autoscale starts at --min-replicas
+        # and lets the queue-depth control loop grow toward --replicas;
+        # static fleets warm all N up front.
         factory = lambda name: serve.GenerationEngine(  # noqa: E731
-            params, cfg, gcfg)
+            params, cfg, gcfg, adapters=_registry())
         initial = args.min_replicas if args.autoscale else args.replicas
-        eng = serve.FleetRouter(factory=factory, initial=initial)
+        eng = serve.FleetRouter(
+            factory=factory, initial=initial,
+            adapter_source=(adapter_trees.__getitem__
+                            if adapter_trees else None))
         eng.bench_cache_bytes = cache_bytes    # per REPLICA (pool grows
         t0 = time.monotonic()                  # with the fleet)
         warmed = eng.warmup()
@@ -211,7 +270,7 @@ def _build_gen_engine(args):
                 breach_up=2, breach_down=2,
                 cooldown_s=1.0, interval_s=0.25).start()
         return eng
-    eng = serve.GenerationEngine(params, cfg, gcfg)
+    eng = serve.GenerationEngine(params, cfg, gcfg, adapters=_registry())
     eng.bench_cache_bytes = cache_bytes      # stamped into the JSON rows
     t0 = time.monotonic()
     warmed = eng.warmup()
@@ -221,15 +280,27 @@ def _build_gen_engine(args):
     return eng
 
 
+def _stream_digest(streams):
+    import hashlib
+    return hashlib.sha256(repr(sorted(streams)).encode()).hexdigest()
+
+
 def run_gen_point(eng, qps: float, duration: float,
-                  rng: np.random.RandomState, args) -> dict:
+                  rng: np.random.RandomState, args) -> tuple:
     """One generation operating point: open-loop prompt arrivals; TTFT
     and per-user tokens/sec come from the engine-stamped result dicts
     (submit → first token / first → last token). ``--prefix-tokens N``
     prepends a fixed N-token system prompt to every request (the
-    traffic-class shape ``--prefix-reuse`` amortizes)."""
-    import hashlib
+    traffic-class shape ``--prefix-reuse`` amortizes).
 
+    Multi-tenant runs (``--adapters N``) draw each arrival's tenant from
+    the ``--adapter-mix`` weights with a DEDICATED selection RNG and its
+    prompt from a per-tenant seeded RNG — so tenant ``t``'s k-th request
+    is identical in every run of the same knobs, whatever the other
+    tenants did. ``--adapter-only t`` replays the same schedule but
+    submits only ``t``'s requests: the single-tenant reference whose
+    per-tenant digest a mixed run must match. Returns
+    ``(row, streams_by_tenant)``."""
     from horovod_tpu.exceptions import (DeadlineExceededError,
                                         ServerOverloadedError)
     n = max(1, int(qps * duration))
@@ -238,28 +309,48 @@ def run_gen_point(eng, qps: float, duration: float,
     # reuse-on vs reuse-off runs see the SAME system prompt.
     sys_prefix = np.random.RandomState(1234).randint(
         1, 255, size=args.prefix_tokens).tolist()
+    tenants, weights = _bench_tenants(args)
+    # Tenant selection and per-tenant prompts ride their own RNGs; the
+    # base-only path keeps drawing prompts from the caller's rng so the
+    # single-tenant digests of existing ci legs are unchanged.
+    pick_rng = np.random.RandomState(4321)
+    prompt_rngs = ({"base": rng} if len(tenants) == 1
+                   else {t: np.random.RandomState(7000 + i)
+                         for i, t in enumerate(tenants)})
     handles = []
     overload = 0
+    sent_by_tenant = {t: 0 for t in tenants}
     start = time.monotonic()
     for i in range(n):
         delay = start + i * period - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        prompt = sys_prefix + rng.randint(
-            1, 255, size=rng.randint(4, 17)).tolist()
+        t = (tenants[0] if len(tenants) == 1
+             else tenants[pick_rng.choice(len(tenants), p=weights)])
+        trng = prompt_rngs[t]
+        prompt = sys_prefix + trng.randint(
+            1, 255, size=trng.randint(4, 17)).tolist()
+        if args.adapter_only and t != args.adapter_only:
+            continue        # reference run: same schedule, one tenant
+        sent_by_tenant[t] += 1
         try:
-            handles.append(eng.submit(prompt))
+            kw = {} if t == "base" else {"adapter": t}
+            handles.append((t, eng.submit(prompt, **kw)))
         except ServerOverloadedError:
             overload += 1
     ttft_ms, tps_user, tokens_out = [], [], 0
     expired, failed = 0, 0
     streams = []
-    for h in handles:
+    streams_by_tenant = {t: [] for t in tenants}
+    done_by_tenant = {t: 0 for t in tenants}
+    for t, h in handles:
         try:
             r = h.result(timeout=120)
             ttft_ms.append(r["ttft_ms"])
             tokens_out += r["n_tokens"]
             streams.append(tuple(r["tokens"]))
+            streams_by_tenant[t].append(tuple(r["tokens"]))
+            done_by_tenant[t] += 1
             if r["tokens_per_sec"] is not None:
                 tps_user.append(r["tokens_per_sec"])
         except DeadlineExceededError:
@@ -272,11 +363,13 @@ def run_gen_point(eng, qps: float, duration: float,
     # prompts + greedy sampling must give an identical digest whatever
     # the batch composition was — the ci.sh prefix-reuse leg pins
     # reuse-on == reuse-off through this field.
-    digest = hashlib.sha256(repr(sorted(streams)).encode()).hexdigest()
+    digest = _stream_digest(streams)
     gen = snap["generation"]
     row = {
         "qps_target": qps,
-        "sent": n,
+        # The requests actually SUBMITTED (an --adapter-only reference
+        # run skips other tenants' arrivals by design).
+        "sent": sum(sent_by_tenant.values()),
         "completed": len(ttft_ms),
         "ttft_p50_ms": _percentile(ttft_ms, 0.50),
         "ttft_p99_ms": _percentile(ttft_ms, 0.99),
@@ -299,7 +392,21 @@ def run_gen_point(eng, qps: float, duration: float,
         "prefix_misses_total": gen["prefix_misses_total"],
         "prefix_hit_blocks_total": gen["prefix_hit_blocks_total"],
         "stream_digest": digest,
+        # Multi-tenant adapter fields — stamped in EVERY generate row
+        # (zeros/base-only when --adapters is off) so a consumer never
+        # key-errors across operating modes.
+        "adapters": args.adapters,
+        "adapter_mix": dict(zip(tenants, weights)),
+        "adapter_only": args.adapter_only or None,
+        "tenant_sent": sent_by_tenant,
+        "tenant_completed": done_by_tenant,
+        "stream_digests": {t: _stream_digest(s)
+                           for t, s in streams_by_tenant.items()},
+        "rejected_tenant_quota": snap.get("rejected_tenant_quota", 0),
+        "tenants": snap.get("tenants") or {},
     }
+    if snap.get("adapters_resident") is not None:
+        row["adapters_resident"] = snap["adapters_resident"]
     if snap["kv_layout"] == "paged" and "block_size" in snap:
         row["block_size"] = snap["block_size"]
         row["blocks"] = snap.get("blocks")
@@ -310,7 +417,9 @@ def run_gen_point(eng, qps: float, duration: float,
         row["replicas"] = snap["fleet"]["replicas"]
         row["scale_events"] = snap["fleet"]["scale_events"]
         row["dispatch"] = snap["fleet"]["dispatch_total"]
-    return row
+        if "adapter_dispatch" in snap["fleet"]:
+            row["adapter_dispatch"] = snap["fleet"]["adapter_dispatch"]
+    return row, streams_by_tenant
 
 
 def run_point(eng, qps: float, duration: float, rng: np.random.RandomState,
@@ -418,6 +527,23 @@ def main():
                    help="[generate] fixed system-prompt tokens prepended "
                         "to every request (the prefix-reuse traffic "
                         "shape)")
+    p.add_argument("--adapters", type=int, default=0,
+                   help="[generate] seeded LoRA fine-tunes (tenants "
+                        "a0..aN-1) loaded next to the base model; every "
+                        "JSON row then stamps the per-tenant fields "
+                        "(docs/inference.md 'Multi-tenant adapters')")
+    p.add_argument("--adapter-rank", type=int, default=4,
+                   help="[generate, --adapters] LoRA rank of the bench "
+                        "fine-tunes")
+    p.add_argument("--adapter-mix", default="",
+                   help="[generate, --adapters] comma-separated arrival "
+                        "weights, base first then a0..aN-1 (default "
+                        "uniform)")
+    p.add_argument("--adapter-only", default="",
+                   help="[generate, --adapters] replay the same arrival "
+                        "schedule submitting ONLY this tenant's requests "
+                        "(base|aK) — the single-tenant digest reference "
+                        "the ci.sh multi-tenant drill compares against")
     p.add_argument("--replicas", type=int, default=1,
                    help="[generate] engine replicas behind one "
                         "FleetRouter (static fleet; with --autoscale "
@@ -452,6 +578,24 @@ def main():
                 "nothing)")
     if args.autoscale and args.min_replicas > args.replicas:
         p.error("--min-replicas must be <= --replicas (the grow ceiling)")
+    if args.adapters < 0:
+        p.error("--adapters must be >= 0")
+    if args.adapters and args.mode != "generate":
+        p.error("--adapters applies to --mode generate only")
+    if args.adapter_mix and not args.adapters:
+        p.error("--adapter-mix needs --adapters N")
+    if args.mode == "generate":
+        try:
+            # ONE naming/weights rule — the same call the run schedule
+            # uses; fail fast, before model build + warmup.
+            tenants, _ = _bench_tenants(args)
+        except SystemExit as e:
+            p.error(str(e))
+        if args.adapter_only and args.adapter_only not in tenants:
+            p.error(f"--adapter-only must be one of {tenants} "
+                    f"(set --adapters first)")
+    elif args.adapter_only:
+        p.error("--adapter-only applies to --mode generate only")
 
     if args.mode == "generate":
         run_generate(args)
@@ -487,7 +631,7 @@ def main():
     print("SERVE BENCH OK")
 
 
-def _fleet_settle(eng, args, lost_streams: int):
+def _fleet_settle(eng, args, lost_streams: int, streams_by_tenant=None):
     """The closed loop's back half: traffic has stopped, so the
     autoscaler must DRAIN the extra replicas (finishing every admitted
     stream) and shrink back to the floor. Waits for the membership to
@@ -504,7 +648,7 @@ def _fleet_settle(eng, args, lost_streams: int):
             time.sleep(0.25)
         scaler.stop()
     snap = eng.stats()
-    return {
+    row = {
         "fleet": True,
         "autoscale": bool(args.autoscale),
         "min_replicas": args.min_replicas,
@@ -516,6 +660,15 @@ def _fleet_settle(eng, args, lost_streams: int):
         "dispatch": snap["fleet"]["dispatch_total"],
         "drained_lost_streams": lost_streams,
     }
+    if streams_by_tenant is not None:
+        # Per-tenant digest map over the WHOLE run (all operating
+        # points): the summary-line form of the per-row maps, so a CI
+        # drill can compare tenants across whole runs in one line.
+        row["stream_digests"] = {t: _stream_digest(s)
+                                 for t, s in streams_by_tenant.items()}
+    if "adapter_dispatch" in snap["fleet"]:
+        row["adapter_dispatch"] = snap["fleet"]["adapter_dispatch"]
+    return row
 
 
 def run_generate(args):
@@ -532,8 +685,12 @@ def run_generate(args):
     dropped_in_deadline = 0
     failed_total = 0
     total_tps = 0.0
+    all_streams: dict = {}
     for q in points:
-        row = run_gen_point(eng, q, args.duration, rng, args)
+        row, streams_by_tenant = run_gen_point(eng, q, args.duration,
+                                               rng, args)
+        for t, s in streams_by_tenant.items():
+            all_streams.setdefault(t, []).extend(s)
         dropped_in_deadline += row["overload_drops"] + row["failed"]
         failed_total += row["failed"]
         total_tps += row["tokens_per_sec"]
@@ -552,7 +709,7 @@ def run_generate(args):
             eng.shutdown(drain=False)
             sys.exit(1)
     if fleet:
-        fleet_row = _fleet_settle(eng, args, failed_total)
+        fleet_row = _fleet_settle(eng, args, failed_total, all_streams)
         print(json.dumps(fleet_row))
         if args.json:
             with open(args.json, "a") as f:

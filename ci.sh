@@ -156,6 +156,84 @@ print(f"autoscaler closed loop OK: grow x{fleet['scale_events']['grow']}"
 print("FLEET AUTOSCALER OK")
 PYEOF
 
+echo "== multi-tenant adapters: per-tenant digest drill (2 LoRA tenants + base, ONE engine) =="
+# ISSUE 14 acceptance: 2 adapters + base traffic through one engine —
+# a mixed-adapter decode batch is ONE compiled program and every
+# tenant's stream must be bit-identical to a single-tenant reference
+# run of the same seeded schedule (--adapter-only replays the schedule
+# submitting only that tenant). Digests are completion-order-free, so
+# batch composition can differ arbitrarily; tokens may not.
+rm -f /tmp/hvd_mt_mix.json /tmp/hvd_mt_base.json /tmp/hvd_mt_a0.json /tmp/hvd_mt_a1.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --adapters 2 --json /tmp/hvd_mt_mix.json
+for t in base a0 a1; do
+  run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+    --qps 20 --duration 5 --deadline-ms 0 --adapters 2 --adapter-only $t \
+    --json /tmp/hvd_mt_$t.json
+done
+python - <<'PYEOF'
+import json
+mix = [json.loads(l) for l in open("/tmp/hvd_mt_mix.json")][-1]
+assert mix["completed"] == mix["sent"] and mix["failed"] == 0, mix
+assert mix["adapters_resident"] == 2, mix.get("adapters_resident")
+for t in ("base", "a0", "a1"):
+    solo = [json.loads(l) for l in open(f"/tmp/hvd_mt_{t}.json")][-1]
+    assert solo["completed"] == solo["sent"] and solo["failed"] == 0, solo
+    assert solo["tenant_sent"][t] == mix["tenant_sent"][t], \
+        f"{t}: schedule replay drifted ({solo['tenant_sent']} vs {mix['tenant_sent']})"
+    assert mix["stream_digests"][t] == solo["stream_digests"][t], \
+        f"tenant {t}: mixed-batch stream differs from its single-tenant run"
+# per-tenant latency split must be populated for every tenant
+for t in ("base", "a0", "a1"):
+    assert mix["tenants"][t]["generations_total"] == mix["tenant_completed"][t], mix["tenants"]
+print("multi-tenant digests OK: base/a0/a1 each bit-identical mixed vs solo "
+      f"({mix['completed']} streams mixed)")
+PYEOF
+
+echo "== multi-tenant adapters: hot-evict under traffic (refusal while referenced, zero lost streams) =="
+run_cpu timeout -k 10 240 python - <<'PYEOF'
+import time
+import jax, jax.numpy as jnp
+from horovod_tpu import serve
+from horovod_tpu.parallel.transformer import TransformerConfig, init_params
+from horovod_tpu.parallel.lora import LoraConfig, init_adapter
+
+cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                        dtype=jnp.float32, unembed_dtype=jnp.float32,
+                        attn_backend="xla")
+params = init_params(jax.random.PRNGKey(0), cfg)
+lora = LoraConfig(rank=2)
+reg = serve.AdapterRegistry(cfg, lora, capacity=2)
+reg.load("a0", init_adapter(jax.random.PRNGKey(1), cfg, lora, b_scale=0.5))
+reg.load("a1", init_adapter(jax.random.PRNGKey(2), cfg, lora, b_scale=0.5))
+eng = serve.GenerationEngine(
+    params, cfg,
+    serve.GenerationConfig(max_slots=2, max_len=64,
+                           default_max_new_tokens=48), adapters=reg)
+ref = eng.generate([5, 4, 3], adapter="a0", timeout=120)   # quiet reference
+h = eng.submit([5, 4, 3], adapter="a0", max_new_tokens=48)  # long live stream
+# The row reference is taken AT SUBMIT (caller's thread), so the evict
+# attempt races nothing: the refcount holds until the stream completes.
+try:
+    reg.evict("a0")
+    raise SystemExit("FAIL: evict succeeded while a live stream references a0")
+except RuntimeError as e:
+    assert "referenced" in str(e), e
+r = h.result(120)
+assert r["tokens"] == ref["tokens"], \
+    "FAIL: eviction attempt perturbed a live stream"
+reg.evict("a0")                         # stream done: refcount 0, allowed
+assert "a0" not in reg.resident()
+n_compiled = len(eng._compiled)
+reg.load("a2", init_adapter(jax.random.PRNGKey(3), cfg, lora, b_scale=0.5))
+out = eng.generate([5, 4, 3], adapter="a2", timeout=120)    # row reused
+assert out["n_tokens"] > 0 and len(eng._compiled) == n_compiled, \
+    "FAIL: hot load recompiled"
+eng.shutdown()
+print("hot-evict drill OK: refusal while referenced, stream finished "
+      f"bit-identical ({r['n_tokens']} tokens), row reused with no recompile")
+PYEOF
+
 echo "== striped host reduce (multi-core validation, gated on nproc) =="
 if [ "$(nproc)" -gt 1 ]; then
   # On a >=4-core host, striping must not LOSE to the serial reduce at

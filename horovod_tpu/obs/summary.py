@@ -145,6 +145,14 @@ class FleetPoller:
                     if name == "hvd_queue_depth")
         parts = [f"fleet: {ready}/{total} replicas ready",
                  f"depth={int(depth)}"]
+        # Adapter residency (multi-tenant serving): the router-level
+        # distinct count — present only when some replica carries a
+        # registry, read from the SAME labeled parse as everything else
+        # (one scrape per endpoint per poll, the PR-13 rule).
+        for (name, _labels), v in merged.items():
+            if name == "hvd_fleet_adapters_resident":
+                parts.append(f"adapters={int(v)} resident")
+                break
         buckets: Dict[str, float] = {}
         for (name, labels), v in merged.items():
             if name == "hvd_generate_ttft_seconds_bucket":

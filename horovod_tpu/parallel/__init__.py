@@ -6,7 +6,20 @@ parallelism, GPipe pipeline parallelism, and GShard expert parallelism —
 all as shard_map-native building blocks over `create_hybrid_mesh`.
 """
 
-from .checkpoint import restore_sharded, save_sharded  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    restore_adapter,
+    restore_sharded,
+    save_adapter,
+    save_sharded,
+)
+from .lora import (  # noqa: F401
+    LoraConfig,
+    adapter_bytes,
+    check_adapter,
+    check_adapter_name,
+    init_adapter,
+    stack_adapters,
+)
 from .kv_blocks import (  # noqa: F401
     BlockManager,
     blocks_for,

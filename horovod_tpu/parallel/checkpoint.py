@@ -461,6 +461,69 @@ def restore_sharded(directory: str, params_template: Any,
     return restored["params"], restored["opt_state"], int(step)
 
 
+# ---------------------------------------------------------------------------
+# LoRA adapter persistence (the multi-tenant serving plane): one directory
+# per adapter, manifest-CRC-verified exactly like the base checkpoints, so
+# a hot-load can PROVE the delta it is about to serve. An adapter is tiny
+# (rank-r pairs; parallel/lora.py has the math) — the full-read verify that
+# would be expensive per training commit costs microseconds here.
+# ---------------------------------------------------------------------------
+
+
+def adapter_path(directory: str, name: str) -> str:
+    """Where the adapter ``name`` lives under ``directory``
+    (``adapter_<name>``, next to the base ``ckpt_<step>`` dirs). The
+    name rule is shared with :class:`~horovod_tpu.serve.adapters.
+    AdapterRegistry` (one identifier grammar everywhere an adapter name
+    travels — paths, labels, prefix-reuse salts)."""
+    from .lora import check_adapter_name
+    check_adapter_name(name)
+    return os.path.join(os.path.abspath(directory), f"adapter_{name}")
+
+
+def save_adapter(directory: str, name: str, adapter: Any) -> str:
+    """Write the adapter tree to ``<directory>/adapter_<name>`` with its
+    integrity manifest (:func:`write_manifest` — same ordering contract
+    as the base flavors: manifest strictly after the orbax write
+    finalizes). Base checkpoints in the same directory are untouched;
+    returns the adapter path."""
+    import orbax.checkpoint as ocp
+    path = adapter_path(directory, name)
+    tree = jax.tree_util.tree_map(np.asarray, adapter)
+    ocp.PyTreeCheckpointer().save(path, tree, force=True)
+    write_manifest(path, tree, extra_meta={"adapter_name": name})
+    return path
+
+
+def restore_adapter(directory: str, name: str, *,
+                    verify: bool = True) -> Any:
+    """Read the adapter ``name`` back as a host tree, CRC-verifying every
+    leaf against its manifest first (the same verify walk the base
+    restore chain uses): a corrupt adapter raises
+    :class:`~horovod_tpu.exceptions.CheckpointCorruptError` naming the
+    path and the offending leaf — and the base weights it would have
+    ridden on are never touched, so one tenant's rotted delta cannot
+    take the whole engine down."""
+    import orbax.checkpoint as ocp
+    path = adapter_path(directory, name)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no adapter {name!r} under {directory} "
+                                f"(looked for {path})")
+    try:
+        restored = ocp.PyTreeCheckpointer().restore(path)
+    except Exception as e:  # noqa: BLE001 — any read failure IS corruption
+        raise CheckpointCorruptError(
+            path, f"unreadable adapter: {type(e).__name__}: {e}") from e
+    if verify:
+        manifest = read_manifest(path)
+        if manifest is None:
+            raise CheckpointCorruptError(
+                path, f"no {MANIFEST_NAME} — cannot verify adapter "
+                      f"integrity")
+        _verify_leaves(path, manifest, restored)
+    return restored
+
+
 #: restore_for_inference's serving dtypes. None = as stored; "int8" is
 #: weight-only per-channel quantization (ops/quant.py) the generation
 #: forward dequantizes in-jit.

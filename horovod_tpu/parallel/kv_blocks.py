@@ -111,7 +111,9 @@ def paged_kv_cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict:
 
 
 def paged_prefill(params, tokens, cache: Dict, slot, write_row,
-                  cfg: TransformerConfig, length=None) -> Tuple[Dict, Any]:
+                  cfg: TransformerConfig, length=None, *,
+                  adapters=None, adapter_idx=None,
+                  lora=None) -> Tuple[Dict, Any]:
     """Full-prompt forward scattering every position's K/V through
     ``write_row`` into the block pool.
 
@@ -124,6 +126,8 @@ def paged_prefill(params, tokens, cache: Dict, slot, write_row,
         :data:`TRASH_BLOCK`, so a prefill can never write into a block
         another stream reads.
       length: true prompt length (defaults to ``T``).
+      adapters / adapter_idx / lora: the LoRA hook, exactly as in the
+        contiguous ``prefill`` (scalar ``adapter_idx``, ``-1`` = base).
 
     Returns ``(cache', logits [T, vocab] f32)``. The attention is the
     same self-contained ``flash_attention`` as the contiguous
@@ -132,6 +136,10 @@ def paged_prefill(params, tokens, cache: Dict, slot, write_row,
     bucket (the cross-layout contract ``tests/test_paged_kv.py`` pins).
     """
     _check_dense(cfg, "paged_prefill")
+    from .lora import make_delta
+    delta = make_delta("prompt", adapters,
+                       -1 if adapter_idx is None else adapter_idx,
+                       lora, cfg)
     params = _gen_weights(params)
     T = tokens.shape[0]
     bs = cache["k"].shape[2]
@@ -163,7 +171,7 @@ def paged_prefill(params, tokens, cache: Dict, slot, write_row,
                 v_pool, v[start:start + rows]
                 .astype(v_pool.dtype)[None, None], idx)
 
-    logits = _prompt_forward(params, tokens, cfg, store)
+    logits = _prompt_forward(params, tokens, cfg, store, delta=delta)
     lengths = cache["lengths"].at[slot].set(length)
     return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
 
@@ -171,7 +179,9 @@ def paged_prefill(params, tokens, cache: Dict, slot, write_row,
 def paged_decode_step(params, last_tokens, cache: Dict, positions,
                       block_tables, cfg: TransformerConfig, *,
                       kernel: bool = False,
-                      interpret: Optional[bool] = None) -> Tuple[Dict, Any]:
+                      interpret: Optional[bool] = None,
+                      adapters=None, adapter_idx=None,
+                      lora=None) -> Tuple[Dict, Any]:
     """One autoregressive step for every slot, through the block table.
 
     Args:
@@ -194,10 +204,17 @@ def paged_decode_step(params, last_tokens, cache: Dict, positions,
 
     Returns ``(cache', logits [S, vocab] f32)`` with the same per-slot
     row-independence contract as ``decode_step``.
+    ``adapters``/``adapter_idx``/``lora`` are the per-slot LoRA hook,
+    exactly as in the contiguous ``decode_step``.
     """
     _check_dense(cfg, "paged_decode_step")
-    params = _gen_weights(params)
     S = last_tokens.shape[0]
+    from .lora import make_delta
+    delta = make_delta(
+        "step", adapters,
+        jnp.full((S,), -1, jnp.int32) if adapter_idx is None
+        else adapter_idx, lora, cfg)
+    params = _gen_weights(params)
     d_head = cfg.d_model // cfg.n_heads
     bs = cache["k"].shape[2]
     max_blocks = block_tables.shape[1]
@@ -223,7 +240,7 @@ def paged_decode_step(params, last_tokens, cache: Dict, positions,
             S, max_blocks * bs, cfg.n_heads, d_head)
         return _cached_attention(q, kg, vg, pos)
 
-    logits = _step_forward(params, last_tokens, cfg, mix)
+    logits = _step_forward(params, last_tokens, cfg, mix, delta=delta)
     lengths = jnp.where(active, pos + 1, cache["lengths"]
                         ).astype(jnp.int32)
     return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
@@ -252,7 +269,12 @@ class BlockManager:
     prefix (``tokens[:j·block_size].tobytes()``), so a hit requires the
     ENTIRE preceding prefix to match — exactly the condition under which
     the cached K/V (a causal function of the preceding tokens) is valid
-    for the new stream.
+    for the new stream. ``salt`` extends that condition to everything
+    else the K/V is a function of: a multi-tenant engine passes the
+    request's (adapter, load-generation) identity, because a LoRA
+    delta changes the K/V a prompt writes — two tenants' identical
+    token prefixes are NOT interchangeable bytes, and neither are one
+    tenant's before/after a hot-reload.
     """
 
     def __init__(self, n_blocks: int, block_size: int):
@@ -344,18 +366,20 @@ class BlockManager:
 
     # -- prefix registry ---------------------------------------------------
 
-    def _key(self, tokens: np.ndarray, j: int) -> bytes:
-        return np.ascontiguousarray(
+    def _key(self, tokens: np.ndarray, j: int, salt: bytes) -> bytes:
+        return salt + np.ascontiguousarray(
             tokens[:(j + 1) * self._bs], dtype=np.int32).tobytes()
 
-    def lookup_prefix(self, tokens: np.ndarray) -> List[int]:
+    def lookup_prefix(self, tokens: np.ndarray,
+                      salt: bytes = b"") -> List[int]:
         """Longest chain of registered full blocks matching the prompt's
-        block-aligned prefix; touches hits MRU so reclaim evicts cold
+        block-aligned prefix UNDER ``salt`` (the writer-identity key —
+        see class docstring); touches hits MRU so reclaim evicts cold
         prefixes first."""
         with self._lock:
             hits: List[int] = []
             for j in range(len(tokens) // self._bs):
-                key = self._key(tokens, j)
+                key = self._key(tokens, j, salt)
                 blk = self._registry.get(key)
                 if blk is None:
                     break
@@ -364,12 +388,12 @@ class BlockManager:
             return hits
 
     def register_prefix(self, tokens: np.ndarray, blocks: List[int],
-                        n_full: int) -> None:
+                        n_full: int, salt: bytes = b"") -> None:
         """Pin the prompt's first ``n_full`` blocks in the registry
-        (idempotent for already-registered chains)."""
+        under ``salt`` (idempotent for already-registered chains)."""
         with self._lock:
             for j in range(n_full):
-                key = self._key(tokens, j)
+                key = self._key(tokens, j, salt)
                 if key in self._registry:
                     self._registry.move_to_end(key)
                     continue

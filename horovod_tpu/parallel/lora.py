@@ -1,0 +1,287 @@
+"""LoRA adapters for the generation transformer: M fine-tunes, one base.
+
+"Millions of users" in practice means thousands of fine-tuned variants
+of ONE base model, not one model per tenant (ROADMAP item 5). Full
+fine-tunes don't fit that shape — every variant would cost a second copy
+of the base weights in HBM and its own engine — but rank-``r`` LoRA
+deltas do: a tenant's fine-tune is ``W + (alpha/r) · A @ B`` per target
+matrix, where ``A [d_in, r]`` and ``B [r, d_out]`` cost
+``4·r·(d_in + d_out)`` bytes against the ``4·d_in·d_out`` of the full
+matrix — at ``r=8`` on a 4096-wide model that is ~250x smaller, so
+hundreds of tenants share one resident base.
+
+The serving-critical property is HOW the delta is applied. Stacking M
+adapters into ``[M, d_in, r]`` / ``[M, r, d_out]`` tables and giving
+every decode slot an ``adapter_idx`` (``-1`` = base) turns tenant
+identity into *data*: the delta is a gather + two batched low-rank
+matmuls inside the SAME jitted ``prefill``/``decode_step``, so a
+mixed-adapter decode batch stays ONE fixed-shape compiled program —
+adapter_idx is never a compile key, and hot-loading a tenant never
+recompiles anything (the contract ``tests/test_adapters.py`` pins).
+
+Per-slot rows stay numerically independent (the gather takes row ``s``'s
+own ``A``/``B``; both einsums contract within a row), so a tenant's
+stream is bit-identical whether it decodes alone, in a mixed-adapter
+batch, or interleaved with base traffic — base rows are guarded with a
+``where`` select (never ``y + 0.0``, which would flip a ``-0.0``), so a
+base stream through an adapter-enabled engine is bit-identical to one
+through a plain engine.
+
+Adapter param trees mirror ``transformer.init_params``'s layer list:
+``{"layers": [{target: {"a": [d_in, r], "b": [r, d_out]}}, ...]}`` with
+targets drawn from :data:`LORA_TARGETS` (the four dense matmuls of each
+block). Device-table lifecycle (capacity, hot-load/evict, refcounts,
+quotas) lives in :class:`horovod_tpu.serve.adapters.AdapterRegistry`;
+persistence with the manifest-CRC walk in
+``parallel.checkpoint.save_adapter``/``restore_adapter``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig
+
+#: The per-layer dense matmuls a LoRA delta can target, in forward order.
+LORA_TARGETS = ("wqkv", "wo", "w1", "w2")
+
+# Adapter names are identifiers, not free text: they become checkpoint
+# directory suffixes, Prometheus label values, AND components of the
+# engine's prefix-reuse registry salt — where a name containing "\x00"
+# plus digits could forge another (name, generation) pair's key and
+# alias two tenants' cached K/V. This charset makes the salt's
+# "name\x00gen\x00" framing unambiguous by construction.
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+#: Tenant keys the serving plane claims for itself: ``base`` is the
+#: adapter-less traffic class (quotas/metrics/in-flight accounting key
+#: on it) and ``retired`` the metric-fold aggregate for evicted tenants
+#: — an adapter under either name would conflate two traffic classes.
+RESERVED_ADAPTER_NAMES = ("base", "retired")
+
+
+def check_adapter_name(name: str) -> str:
+    """Validate (and return) an adapter name; ``ValueError`` otherwise."""
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise ValueError(
+            f"adapter name must match {_NAME_RE.pattern} (letters, "
+            f"digits, '._-', max 128 chars), got {name!r}")
+    if name in RESERVED_ADAPTER_NAMES:
+        raise ValueError(
+            f"adapter name {name!r} is reserved "
+            f"({RESERVED_ADAPTER_NAMES}: the adapter-less traffic class "
+            f"and the evicted-tenant metric fold)")
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Adapter shape knobs. ``rank`` is the low-rank width ``r``;
+    ``alpha`` the usual LoRA numerator (applied delta is scaled by
+    ``alpha / rank``); ``targets`` the per-layer matmuls carrying a
+    delta (default: all four)."""
+
+    rank: int = 4
+    alpha: float = 8.0
+    targets: Tuple[str, ...] = LORA_TARGETS
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if not self.alpha > 0:
+            raise ValueError(f"alpha must be > 0, got {self.alpha}")
+        targets = tuple(self.targets)
+        if not targets:
+            raise ValueError("targets must name at least one matmul")
+        bad = [t for t in targets if t not in LORA_TARGETS]
+        if bad:
+            raise ValueError(
+                f"unknown LoRA target(s) {bad}; supported: {LORA_TARGETS}")
+        object.__setattr__(self, "targets", targets)
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def target_shapes(cfg: TransformerConfig) -> Dict[str, Tuple[int, int]]:
+    """``target -> (d_in, d_out)`` of the base matmuls a delta rides."""
+    d = cfg.d_model
+    return {"wqkv": (d, 3 * d), "wo": (d, d),
+            "w1": (d, cfg.d_ff), "w2": (cfg.d_ff, d)}
+
+
+def adapter_bytes(cfg: TransformerConfig, lora: LoraConfig) -> int:
+    """Host/HBM bytes of ONE adapter (f32 A/B pairs) — the number the
+    docs' memory math quotes against a full fine-tune."""
+    shapes = target_shapes(cfg)
+    per_layer = sum(4 * lora.rank * (shapes[t][0] + shapes[t][1])
+                    for t in lora.targets)
+    return cfg.n_layers * per_layer
+
+
+def init_adapter(rng, cfg: TransformerConfig, lora: LoraConfig,
+                 b_scale: float = 0.0) -> Dict:
+    """Fresh adapter tree: ``A ~ N(0, 1/d_in)`` and ``B = 0`` (the
+    standard LoRA init — the delta starts exactly zero). ``b_scale > 0``
+    randomizes ``B`` instead (useful for tests/benches that need M
+    DISTINCT tenants without running M fine-tunes)."""
+    shapes = target_shapes(cfg)
+    keys = jax.random.split(rng, 2 * cfg.n_layers * len(lora.targets))
+    ki = iter(range(len(keys)))
+    layers = []
+    for _ in range(cfg.n_layers):
+        layer = {}
+        for t in lora.targets:
+            d_in, d_out = shapes[t]
+            a = (jax.random.normal(keys[next(ki)], (d_in, lora.rank))
+                 * d_in ** -0.5)
+            kb = keys[next(ki)]
+            b = (jax.random.normal(kb, (lora.rank, d_out)) * b_scale
+                 if b_scale else jnp.zeros((lora.rank, d_out)))
+            layer[t] = {"a": a.astype(jnp.float32),
+                        "b": jnp.asarray(b, jnp.float32)}
+        layers.append(layer)
+    return {"layers": layers}
+
+
+def check_adapter(adapter: Any, cfg: TransformerConfig,
+                  lora: LoraConfig) -> None:
+    """Eagerly reject an adapter tree that does not fit (cfg, lora) —
+    a shape mismatch must fail at load time with the culprit named, not
+    surface as an XLA error inside a decode step."""
+    shapes = target_shapes(cfg)
+    layers = adapter.get("layers") if isinstance(adapter, dict) else None
+    if layers is None or len(layers) != cfg.n_layers:
+        raise ValueError(
+            f"adapter tree must be {{'layers': [... x {cfg.n_layers}]}}, "
+            f"got layers="
+            f"{None if layers is None else len(layers)}")
+    for li, layer in enumerate(layers):
+        if set(layer) != set(lora.targets):
+            raise ValueError(
+                f"adapter layer {li} targets {sorted(layer)} != "
+                f"configured {sorted(lora.targets)}")
+        for t, pair in layer.items():
+            d_in, d_out = shapes[t]
+            if not isinstance(pair, dict) or set(pair) != {"a", "b"}:
+                raise ValueError(
+                    f"adapter layer {li} target {t!r} must be a "
+                    f"{{'a', 'b'}} pair, got "
+                    f"{sorted(pair) if isinstance(pair, dict) else type(pair).__name__}")
+            a_shape = tuple(jnp.shape(pair["a"]))
+            b_shape = tuple(jnp.shape(pair["b"]))
+            if a_shape != (d_in, lora.rank) or b_shape != (lora.rank,
+                                                           d_out):
+                raise ValueError(
+                    f"adapter layer {li} target {t!r}: a{a_shape} / "
+                    f"b{b_shape} do not match expected "
+                    f"a({d_in}, {lora.rank}) / b({lora.rank}, {d_out})")
+
+
+def stack_adapters(adapters: Sequence[Any]) -> Any:
+    """Stack N same-shaped adapter trees into one ``[N, ...]``-leaved
+    table (the gather target of the batched application)."""
+    if not adapters:
+        raise ValueError("stack_adapters needs at least one adapter")
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *adapters)
+
+
+def empty_adapter_table(cfg: TransformerConfig, lora: LoraConfig,
+                        capacity: int) -> Any:
+    """All-zero stacked table of ``capacity`` rows — a zero row IS the
+    base model (delta 0), so unoccupied table rows are harmless even if
+    a stale index ever gathered one."""
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    shapes = target_shapes(cfg)
+    layer = {t: {"a": jnp.zeros((capacity, shapes[t][0], lora.rank),
+                                jnp.float32),
+                 "b": jnp.zeros((capacity, lora.rank, shapes[t][1]),
+                                jnp.float32)}
+             for t in lora.targets}
+    return {"layers": [jax.tree_util.tree_map(lambda x: x, layer)
+                       for _ in range(cfg.n_layers)]}
+
+
+# ---------------------------------------------------------------------------
+# Batched application — the delta callbacks transformer._prompt_forward /
+# _step_forward thread past every target matmul. Both run INSIDE the
+# jitted generation programs; adapter_idx is a traced input (data, not a
+# compile key).
+# ---------------------------------------------------------------------------
+
+
+def _table_rows(adapters: Any) -> int:
+    for layer in adapters["layers"]:
+        for pair in layer.values():
+            return int(jnp.shape(pair["a"])[0])
+    raise ValueError("adapter table has no target pairs")
+
+
+def prompt_delta(adapters: Any, adapter_idx, lora: LoraConfig,
+                 cfg: TransformerConfig):
+    """Delta callback for the single-sequence prompt forward: ONE
+    adapter (scalar ``adapter_idx``; ``-1`` = base → the matmul output
+    passes through bit-unchanged via a ``where`` select)."""
+    n = _table_rows(adapters)
+    idx = jnp.asarray(adapter_idx, jnp.int32)
+    safe = jnp.clip(idx, 0, n - 1)
+    scale = jnp.asarray(lora.scaling, cfg.dtype)
+
+    def delta(li, name, x, y):
+        pair = adapters["layers"][li].get(name)
+        if pair is None:
+            return y
+        a = pair["a"][safe].astype(cfg.dtype)      # [d_in, r]
+        b = pair["b"][safe].astype(cfg.dtype)      # [r, d_out]
+        return jnp.where(idx >= 0, y + ((x @ a) @ b) * scale, y)
+
+    return delta
+
+
+def step_delta(adapters: Any, adapter_idx, lora: LoraConfig,
+               cfg: TransformerConfig):
+    """Delta callback for the fixed-shape decode step: per-slot
+    ``adapter_idx [S]`` gathers each row's A/B pair and applies the
+    delta via two batched low-rank einsums. Every contraction stays
+    within its slot row, so the per-slot independence (and therefore
+    the alone-vs-mixed bit-identity) of ``decode_step`` is preserved."""
+    n = _table_rows(adapters)
+    idx = jnp.asarray(adapter_idx, jnp.int32)      # [S]
+    active = idx >= 0
+    safe = jnp.clip(idx, 0, n - 1)
+    scale = jnp.asarray(lora.scaling, cfg.dtype)
+
+    def delta(li, name, x, y):
+        pair = adapters["layers"][li].get(name)
+        if pair is None:
+            return y
+        a = pair["a"][safe].astype(cfg.dtype)      # [S, d_in, r]
+        b = pair["b"][safe].astype(cfg.dtype)      # [S, r, d_out]
+        xa = jnp.einsum("sd,sdr->sr", x, a)
+        d = jnp.einsum("sr,sre->se", xa, b) * scale
+        return jnp.where(active[:, None], y + d, y)
+
+    return delta
+
+
+def make_delta(kind: str, adapters: Any, adapter_idx, lora: LoraConfig,
+               cfg: TransformerConfig):
+    """Shared validation + dispatch for the four generation entry points
+    (contiguous/paged × prefill/decode): ``kind`` is ``"prompt"`` or
+    ``"step"``; returns ``None`` when no adapter table is given."""
+    if adapters is None:
+        return None
+    if lora is None:
+        raise ValueError(
+            "adapters= needs lora=LoraConfig(...) (the rank/alpha/targets "
+            "the table was built with)")
+    builder = prompt_delta if kind == "prompt" else step_delta
+    return builder(adapters, adapter_idx, lora, cfg)

@@ -370,7 +370,14 @@ def kv_cache_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict:
     return {"k": kv, "v": kv, "lengths": P()}
 
 
-def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv):
+def _no_delta(li, name, x, y):
+    """Default adapter hook: the base matmul output passes through
+    untouched (see :mod:`.lora` for the LoRA delta callbacks)."""
+    return y
+
+
+def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv,
+                    delta=None):
     """Shared prompt-phase forward for the contiguous and paged prefills
     (``params`` already through :func:`_gen_weights`): per layer the
     computed K/V is handed to ``store_kv(li, k, v)`` (k/v
@@ -378,51 +385,63 @@ def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv):
     attention is the same self-contained ``flash_attention`` either way,
     so both layouts' prefill logits are bitwise identical by
     construction (the cross-layout contract tests/test_paged_kv.py
-    pins). Returns logits ``[T, vocab]`` f32."""
+    pins). ``delta(li, name, x, y)`` adjusts each target matmul's output
+    (the LoRA hook; the default passes ``y`` through bit-unchanged).
+    Returns logits ``[T, vocab]`` f32."""
     from ..ops.pallas_attention import flash_attention
+    dl = _no_delta if delta is None else delta
     T = tokens.shape[0]
     d_head = cfg.d_model // cfg.n_heads
     x = params["embed"][tokens][None].astype(cfg.dtype)     # [1, T, D]
     for li, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["ln1"])
-        qkv = h @ layer["wqkv"].astype(cfg.dtype)
+        qkv = dl(li, "wqkv", h, h @ layer["wqkv"].astype(cfg.dtype))
         qkv = qkv.reshape(1, T, cfg.n_heads, 3, d_head)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         store_kv(li, k[0], v[0])
         attn = flash_attention(q, k, v, causal=True,
                                backend=cfg.attn_backend).astype(cfg.dtype)
-        x = x + attn.reshape(1, T, cfg.n_heads * d_head) \
-            @ layer["wo"].astype(cfg.dtype)
+        a_flat = attn.reshape(1, T, cfg.n_heads * d_head)
+        x = x + dl(li, "wo", a_flat,
+                   a_flat @ layer["wo"].astype(cfg.dtype))
         h2 = _rms_norm(x, layer["ln2"])
-        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
-        x = x + up @ layer["w2"].astype(cfg.dtype)
+        up = jax.nn.gelu(dl(li, "w1", h2,
+                            h2 @ layer["w1"].astype(cfg.dtype)))
+        x = x + dl(li, "w2", up, up @ layer["w2"].astype(cfg.dtype))
     x = _rms_norm(x, params["lnf"])
     return jnp.matmul(x.astype(cfg.unembed_dtype),
                       params["embed"].T.astype(cfg.unembed_dtype),
                       preferred_element_type=jnp.float32)[0]
 
 
-def _step_forward(params, last_tokens, cfg: TransformerConfig, mix):
+def _step_forward(params, last_tokens, cfg: TransformerConfig, mix,
+                  delta=None):
     """Shared decode-step forward (``params`` already through
     :func:`_gen_weights`): ``mix(li, q, k, v)`` does the layout-specific
     cache write + attention read (q/k/v ``[S, n_heads, d_head]`` → attn
     of the same shape); everything else — the layer math both
-    bit-identity contracts ride on — exists exactly once. Returns
-    logits ``[S, vocab]`` f32."""
+    bit-identity contracts ride on — exists exactly once.
+    ``delta(li, name, x, y)`` adjusts each target matmul's output (the
+    batched per-slot LoRA hook; row-independent by construction, so the
+    alone-vs-mixed bit-identity survives it). Returns logits
+    ``[S, vocab]`` f32."""
+    dl = _no_delta if delta is None else delta
     S = last_tokens.shape[0]
     d_head = cfg.d_model // cfg.n_heads
     x = params["embed"][last_tokens].astype(cfg.dtype)      # [S, D]
     for li, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["ln1"])
-        qkv = (h @ layer["wqkv"].astype(cfg.dtype)
-               ).reshape(S, cfg.n_heads, 3, d_head)
+        qkv = dl(li, "wqkv", h, h @ layer["wqkv"].astype(cfg.dtype)
+                 ).reshape(S, cfg.n_heads, 3, d_head)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         attn = mix(li, q, k, v)
-        x = x + attn.reshape(S, cfg.n_heads * d_head) \
-            @ layer["wo"].astype(cfg.dtype)
+        a_flat = attn.reshape(S, cfg.n_heads * d_head)
+        x = x + dl(li, "wo", a_flat,
+                   a_flat @ layer["wo"].astype(cfg.dtype))
         h2 = _rms_norm(x, layer["ln2"])
-        up = jax.nn.gelu(h2 @ layer["w1"].astype(cfg.dtype))
-        x = x + up @ layer["w2"].astype(cfg.dtype)
+        up = jax.nn.gelu(dl(li, "w1", h2,
+                            h2 @ layer["w1"].astype(cfg.dtype)))
+        x = x + dl(li, "w2", up, up @ layer["w2"].astype(cfg.dtype))
     x = _rms_norm(x, params["lnf"])
     return jnp.matmul(x.astype(cfg.unembed_dtype),
                       params["embed"].T.astype(cfg.unembed_dtype),
@@ -430,7 +449,8 @@ def _step_forward(params, last_tokens, cfg: TransformerConfig, mix):
 
 
 def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
-            length=None) -> Tuple[Dict, Any]:
+            length=None, *, adapters=None, adapter_idx=None,
+            lora=None) -> Tuple[Dict, Any]:
     """Run the full prompt through the model, writing every position's K/V
     into ``cache`` at ``slot``.
 
@@ -441,6 +461,12 @@ def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
       slot: int32 scalar — which cache row to fill (traced, so one
         compiled program serves every slot).
       length: true prompt length (int32 scalar; defaults to ``T``).
+      adapters: optional stacked LoRA table (:mod:`.lora`); with it,
+        ``adapter_idx`` (int32 scalar, ``-1``/None = base) picks the
+        tenant's delta — data, not a compile key, so one compiled
+        program serves every tenant.
+      lora: the :class:`~.lora.LoraConfig` the table was built with
+        (required with ``adapters``).
 
     Returns ``(cache', logits [T, vocab] f32)`` — logits at EVERY prompt
     position, matching one-shot :func:`forward` (the parity contract
@@ -450,6 +476,10 @@ def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
     invariance contract).
     """
     _check_dense(cfg, "prefill")
+    from .lora import make_delta
+    delta = make_delta("prompt", adapters,
+                       -1 if adapter_idx is None else adapter_idx,
+                       lora, cfg)
     params = _gen_weights(params)
     T = tokens.shape[0]
     if T > cache["k"].shape[2]:
@@ -469,7 +499,7 @@ def prefill(params, tokens, cache: Dict, slot, cfg: TransformerConfig,
         v_cache = lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype)[None, None], idx)
 
-    logits = _prompt_forward(params, tokens, cfg, store)
+    logits = _prompt_forward(params, tokens, cfg, store, delta=delta)
     lengths = cache["lengths"].at[slot].set(length)
     return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits
 
@@ -491,7 +521,8 @@ def _cached_attention(q, k_cache, v_cache, positions):
 
 
 def decode_step(params, last_tokens, cache: Dict, positions,
-                cfg: TransformerConfig) -> Tuple[Dict, Any]:
+                cfg: TransformerConfig, *, adapters=None,
+                adapter_idx=None, lora=None) -> Tuple[Dict, Any]:
     """One autoregressive step for every slot at once: embed each slot's
     last sampled token, write its K/V at ``positions[s]``, attend over the
     slot's cache (masked to ``<= positions[s]``), and return next-token
@@ -505,15 +536,27 @@ def decode_step(params, last_tokens, cache: Dict, positions,
         ``-1`` marks an inactive slot, whose output row is garbage to be
         ignored (its scratch write lands at index 0 of a row that the next
         prefill into that slot rewrites before it is ever read).
+      adapters: optional stacked LoRA table (:mod:`.lora`); with it,
+        ``adapter_idx`` ([S] int32, ``-1`` = base row; None = all base)
+        gathers each slot's delta — a mixed-adapter batch stays THIS one
+        compiled program.
+      lora: the :class:`~.lora.LoraConfig` the table was built with
+        (required with ``adapters``).
 
     Returns ``(cache', logits [S, vocab] f32)``. Every per-slot row of the
-    computation depends only on that slot's token, position and cache row,
-    so a request's token stream is bit-identical whether it decodes alone
-    or alongside a full batch (the invariance tests/test_generate.py pins).
+    computation depends only on that slot's token, position, cache row and
+    adapter row, so a request's token stream is bit-identical whether it
+    decodes alone or alongside a full batch (the invariance
+    tests/test_generate.py and tests/test_adapters.py pin).
     """
     _check_dense(cfg, "decode_step")
-    params = _gen_weights(params)
     S = last_tokens.shape[0]
+    from .lora import make_delta
+    delta = make_delta(
+        "step", adapters,
+        jnp.full((S,), -1, jnp.int32) if adapter_idx is None
+        else adapter_idx, lora, cfg)
+    params = _gen_weights(params)
     active = positions >= 0
     pos = jnp.where(active, positions, 0).astype(jnp.int32)
     rows = jnp.arange(S, dtype=jnp.int32)
@@ -525,7 +568,7 @@ def decode_step(params, last_tokens, cache: Dict, positions,
         v_cache = v_cache.at[li, rows, pos].set(v.astype(v_cache.dtype))
         return _cached_attention(q, k_cache[li], v_cache[li], pos)
 
-    logits = _step_forward(params, last_tokens, cfg, mix)
+    logits = _step_forward(params, last_tokens, cfg, mix, delta=delta)
     lengths = jnp.where(active, pos + 1, cache["lengths"]
                         ).astype(jnp.int32)
     return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits

@@ -43,6 +43,7 @@ a queue-depth autoscaler closing the loop
     serve.HttpServer(generate=router).start()
 """
 
+from .adapters import AdapterRegistry  # noqa: F401
 from .batcher import (  # noqa: F401
     Request,
     RequestQueue,
@@ -64,7 +65,16 @@ from .fleet import FleetAutoscaler, heartbeat_liveness  # noqa: F401
 from .server import HttpServer  # noqa: F401
 from ..parallel.checkpoint import (  # noqa: F401
     INFERENCE_DTYPES,
+    restore_adapter,
     restore_for_inference,
+    save_adapter,
+)
+from ..parallel.lora import (  # noqa: F401
+    LoraConfig,
+    adapter_bytes,
+    check_adapter_name,
+    init_adapter,
+    stack_adapters,
 )
 from ..parallel.kv_blocks import (  # noqa: F401
     BlockManager,

@@ -1,0 +1,282 @@
+"""Multi-tenant adapter lifecycle: a fixed-capacity stacked device table.
+
+The model layer (:mod:`horovod_tpu.parallel.lora`) makes tenant identity
+*data* — a per-slot ``adapter_idx`` gathering rows of stacked
+``[capacity, ...]`` LoRA tables inside the one compiled decode program.
+This module owns everything around that table the compiled program must
+never see change shape:
+
+* **Fixed capacity.** The table is allocated ONCE at
+  ``[capacity, ...]`` — capacity is the only compile-relevant number.
+  Loading tenant #37 into a free row is a data update; it never
+  recompiles anything (the compile-cache pin tests/test_adapters.py
+  holds).
+* **Hot-load at a step boundary, never mid-step.** A load stages the
+  adapter host-side, then swaps the row in by building a NEW table tree
+  (``leaf.at[row].set(...)`` — jax arrays are immutable). The engine
+  loop reads :meth:`AdapterRegistry.table` afresh at every
+  prefill/decode invocation, i.e. at a decode-step boundary; a step
+  already in flight keeps the OLD buffers, so a swap can never tear a
+  step, and the next step sees the whole new row or none of it.
+* **Evict refuses while referenced** — the
+  :class:`~horovod_tpu.parallel.kv_blocks.BlockManager` refcount
+  discipline. Every admitted request retains its adapter's row
+  (submit-time, released when the stream finishes or fails), so a row a
+  live stream gathers from can never be freed and overwritten under it.
+  ``evict`` of a referenced adapter raises instead; drain the tenant
+  first.
+* **Per-tenant admission quotas.** ``quota(name)`` caps a tenant's
+  in-flight streams (queued + decoding); the engine rejects over-quota
+  submits with the ``tenant_quota`` reason, split from
+  ``slots_full``/``blocks_exhausted`` exactly as PR 11 split those —
+  an operator must see WHICH resource a tenant exhausted. ``"base"``
+  (no adapter) is a quotable tenant too.
+
+Weights come from anywhere that yields the
+``parallel.lora.init_adapter`` tree shape — typically
+``parallel.checkpoint.restore_adapter`` (manifest-CRC-verified), so a
+rotted fine-tune fails its load loudly and the base model keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.lora import (LoraConfig, check_adapter,
+                             check_adapter_name, empty_adapter_table)
+from ..parallel.transformer import TransformerConfig
+
+
+class AdapterRegistry:
+    """Name → table-row bookkeeping over one stacked LoRA device table.
+
+    Args:
+      model_cfg: the base :class:`~horovod_tpu.parallel.transformer.
+        TransformerConfig` the adapters fine-tune.
+      lora: the :class:`~horovod_tpu.parallel.lora.LoraConfig` every
+        loaded adapter must match (rank/alpha/targets are table shape).
+      capacity: table rows — the max adapters resident at once. Compile
+        surface: pick for the tenant working set, not the tenant count
+        (cold tenants hot-load on demand).
+
+    Thread-safe; the swap itself runs under the lock (adapter rows are
+    tiny — microseconds of dispatch).
+    """
+
+    def __init__(self, model_cfg: TransformerConfig, lora: LoraConfig,
+                 capacity: int = 8):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._model_cfg = model_cfg
+        self._lora = lora
+        self._capacity = int(capacity)
+        self._table = empty_adapter_table(model_cfg, lora, capacity)
+        self._lock = threading.Lock()
+        self._names: Dict[str, int] = {}
+        self._ref = np.zeros(self._capacity, np.int64)
+        self._free: List[int] = list(range(self._capacity - 1, -1, -1))
+        self._quotas: Dict[str, Optional[int]] = {}
+        # Monotone per-name load generation: bumped on EVERY load (fresh
+        # and hot-reload) and never reset by evict — the engine salts
+        # its prefix-reuse registry keys with (name, generation), so a
+        # new adapter loaded under a recycled name can never hit KV
+        # prefixes its predecessor wrote.
+        self._gens: Dict[str, int] = {}
+        self._loads_total = 0
+        self._evictions_total = 0
+        # Fired (outside the lock) after an evict commits: the owning
+        # engine folds the tenant's metric state so tenant churn cannot
+        # grow per-tenant recorders/series without bound.
+        self._evict_listeners: List[Callable[[str], None]] = []
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def lora(self) -> LoraConfig:
+        return self._lora
+
+    @property
+    def model_cfg(self) -> TransformerConfig:
+        return self._model_cfg
+
+    def table(self) -> Any:
+        """The current stacked device table (an immutable tree — pass it
+        straight into the compiled prefill/decode; a concurrent load
+        publishes a NEW tree, it never mutates this one)."""
+        with self._lock:
+            return self._table
+
+    def resident(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._names))
+
+    def index_of(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self._names.get(name)
+
+    # -- load / evict ------------------------------------------------------
+
+    def load(self, name: str, adapter: Any,
+             quota: Optional[int] = None) -> int:
+        """Stage ``adapter`` and swap it into a table row; returns the
+        row index. Re-loading a resident name hot-reloads its weights in
+        place — refused (``RuntimeError``) while any live stream
+        references the row, for the same reason evict refuses: a
+        mid-stream weight change would fork the tenant's stream. A full
+        table raises ``ValueError`` naming the capacity."""
+        check_adapter_name(name)
+        check_adapter(adapter, self._model_cfg, self._lora)
+        staged = jax.tree_util.tree_map(np.asarray, adapter)
+        with self._lock:
+            row = self._names.get(name)
+            if row is not None:
+                if self._ref[row] > 0:
+                    raise RuntimeError(
+                        f"adapter {name!r} is referenced by "
+                        f"{int(self._ref[row])} live stream(s) — a "
+                        f"hot-reload would change tokens mid-stream; "
+                        f"drain the tenant first")
+            else:
+                if not self._free:
+                    raise ValueError(
+                        f"adapter table full ({self._capacity} rows, "
+                        f"resident: {sorted(self._names)}) — evict an "
+                        f"idle adapter or raise capacity")
+                row = self._free.pop()
+            self._table = jax.tree_util.tree_map(
+                lambda t, a: t.at[row].set(jnp.asarray(a, t.dtype)),
+                self._table, staged)
+            self._names[name] = row
+            self._gens[name] = self._gens.get(name, 0) + 1
+            if quota is not None:
+                self._quotas[name] = int(quota)
+            self._loads_total += 1
+            return row
+
+    def evict(self, name: str) -> None:
+        """Free ``name``'s row for a future load. Refuses
+        (``RuntimeError``) while any live stream references the row —
+        the BlockManager discipline: a row is reusable only at
+        refcount 0. The row's bytes are left in place; nothing gathers
+        from an unnamed row, and the next load overwrites it."""
+        with self._lock:
+            row = self._names.get(name)
+            if row is None:
+                raise ValueError(
+                    f"no adapter {name!r} resident "
+                    f"(resident: {sorted(self._names)})")
+            if self._ref[row] > 0:
+                raise RuntimeError(
+                    f"adapter {name!r} is referenced by "
+                    f"{int(self._ref[row])} live stream(s) — refusing to "
+                    f"evict; drain the tenant first")
+            del self._names[name]
+            self._quotas.pop(name, None)
+            self._free.append(row)
+            self._evictions_total += 1
+            listeners = list(self._evict_listeners)
+        for fn in listeners:
+            try:
+                fn(name)
+            except Exception:  # noqa: BLE001 — cleanup must not fail evict
+                pass
+
+    def add_evict_listener(self, fn: Callable[[str], None]) -> None:
+        """Register a post-evict callback (called with the evicted name,
+        outside the registry lock) — the engine's metric-folding hook."""
+        with self._lock:
+            self._evict_listeners.append(fn)
+
+    def remove_evict_listener(self, fn: Callable[[str], None]) -> None:
+        """Unhook a listener (idempotent) — engines unhook at shutdown
+        so a registry SHARED across replicas does not accumulate
+        callbacks bound to retired engines' metrics."""
+        with self._lock:
+            try:
+                self._evict_listeners.remove(fn)
+            except ValueError:
+                pass
+
+    # -- stream references -------------------------------------------------
+
+    def retain(self, name: str) -> int:
+        """One more live-stream reference on ``name``'s row (called at
+        admission); returns the row index the stream's ``adapter_idx``
+        uses for its whole lifetime."""
+        with self._lock:
+            row = self._names.get(name)
+            if row is None:
+                raise ValueError(
+                    f"adapter {name!r} is not resident (resident: "
+                    f"{sorted(self._names)}) — load() it first")
+            self._ref[row] += 1
+            return row
+
+    def release(self, name: str) -> None:
+        """Drop one stream reference (stream finished or failed)."""
+        with self._lock:
+            row = self._names.get(name)
+            if row is None or self._ref[row] <= 0:
+                raise RuntimeError(
+                    f"release of unretained adapter {name!r}")
+            self._ref[row] -= 1
+
+    def refcount(self, name: str) -> int:
+        with self._lock:
+            row = self._names.get(name)
+            return int(self._ref[row]) if row is not None else 0
+
+    def generation(self, name: str) -> int:
+        """How many times ``name`` has been loaded (any weights) —
+        stable for a stream's lifetime once its row is retained (a
+        reload is refused while referenced), which is what makes it a
+        sound prefix-reuse salt component."""
+        with self._lock:
+            if name not in self._names:
+                raise ValueError(
+                    f"adapter {name!r} is not resident (resident: "
+                    f"{sorted(self._names)})")
+            return self._gens[name]
+
+    # -- quotas ------------------------------------------------------------
+
+    def quota(self, tenant: str) -> Optional[int]:
+        """Max in-flight streams for ``tenant`` (``None`` = unlimited).
+        ``"base"`` is a valid tenant — base traffic can be capped too."""
+        with self._lock:
+            return self._quotas.get(tenant)
+
+    def set_quota(self, tenant: str, quota: Optional[int]) -> None:
+        if quota is not None and quota < 1:
+            raise ValueError(f"quota must be >= 1 or None, got {quota}")
+        with self._lock:
+            if quota is None:
+                self._quotas.pop(tenant, None)
+            else:
+                self._quotas[tenant] = int(quota)
+
+    # -- gauges ------------------------------------------------------------
+
+    def gauges(self) -> Dict:
+        """The ``/stats`` adapter-table block: plain json-ready values."""
+        with self._lock:
+            return {
+                "capacity": self._capacity,
+                "resident": len(self._names),
+                "free_rows": len(self._free),
+                "names": sorted(self._names),
+                "refcounts": {n: int(self._ref[i])
+                              for n, i in sorted(self._names.items())},
+                "quotas": dict(sorted(self._quotas.items())),
+                "loads_total": self._loads_total,
+                "evictions_total": self._evictions_total,
+            }

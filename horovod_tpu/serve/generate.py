@@ -67,6 +67,7 @@ from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
                                   paged_prefill)
 from ..parallel.transformer import (TransformerConfig, decode_step,
                                     init_kv_cache, prefill)
+from .adapters import AdapterRegistry
 from .batcher import RequestQueue, bucket_for
 from .engine import ReadinessMixin
 from .metrics import ServeMetrics
@@ -266,6 +267,23 @@ class _GenRequest:
     n_out: int = 0
     t_admit: Optional[float] = None     # dequeued into a slot
     t_first: Optional[float] = None     # first token sampled
+    # Multi-tenant adapter identity: tenant is the quota/metrics key
+    # ("base" for adapter-less traffic), adapter the registry name (None
+    # = base), adapter_slot the table row the stream's adapter_idx pins
+    # for its whole lifetime (resolved at submit, protected by the
+    # registry refcount until _req_done releases it).
+    tenant: str = "base"
+    adapter: Optional[str] = None
+    adapter_slot: int = -1
+    # Prefix-reuse registry salt: a prompt's cached K/V is a function of
+    # the weights that wrote it, so tenants must never hit each other's
+    # prefixes (nor a reloaded adapter its predecessor's). Base traffic
+    # carries the reserved NUL frame, NOT b"": adapter salts start with
+    # a name character ([A-Za-z0-9], never NUL), so a base key can never
+    # byte-equal an adapter key even when crafted token values spell an
+    # adapter's salt — with an unframed b"" it could.
+    prefix_salt: bytes = b"\x00"
+    _done_accounted: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_at is None:
@@ -300,18 +318,36 @@ class GenerationEngine(ReadinessMixin):
       model_cfg: the :class:`~horovod_tpu.parallel.transformer.
         TransformerConfig` the params belong to (dense FFN only).
       config: :class:`GenerationConfig`.
+      adapters: optional :class:`~.adapters.AdapterRegistry` — the
+        multi-tenant plane. With it, ``submit(adapter="name")`` serves
+        that tenant's LoRA fine-tune: the per-slot ``adapter_idx``
+        gathers the tenant's table row inside the SAME compiled
+        prefill/decode programs (one compile cache whether the batch is
+        base-only or mixed-adapter), per-tenant quotas gate admission,
+        and ``/stats``/``/metrics`` split TTFT and tokens by tenant.
     """
 
     def __init__(self, params: Any, model_cfg: TransformerConfig,
-                 config: GenerationConfig = GenerationConfig()):
+                 config: GenerationConfig = GenerationConfig(), *,
+                 adapters: Optional[AdapterRegistry] = None):
         if model_cfg.n_experts:
             raise NotImplementedError(
                 "generation supports dense FFNs only (n_experts=0)")
         self._params = params
         self._model_cfg = model_cfg
         self._cfg = config
+        self._adapters = adapters
+        # Per-slot adapter table row, the decode program's gather index
+        # (-1 = base). Data, not a compile key.
+        self._adapter_idx = np.full((config.max_slots,), -1, np.int32)
+        self._tenant_lock = threading.Lock()
+        self._tenant_inflight: Dict[str, int] = {}
         self._queue = RequestQueue(config.max_queue)
         self._metrics = ServeMetrics()
+        if adapters is not None:
+            # Tenant churn must not grow per-tenant metric state without
+            # bound: fold an evicted tenant's counters into "retired".
+            adapters.add_evict_listener(self._metrics.forget_tenant)
         self._paged = config.kv_layout == "paged"
         s = config.max_slots
         if self._paged:
@@ -373,48 +409,70 @@ class GenerationEngine(ReadinessMixin):
             if exe is None:
                 cfg = self._model_cfg
                 s = self._cfg.max_slots
+                paged = self._paged
+                has_ad = self._adapters is not None
+                lcfg = self._adapters.lora if has_ad else None
                 p_sds = self._sds(self._params)
                 c_sds = self._sds(self._cache)
+                a_sds = (self._sds(self._adapters.table())
+                         if has_ad else None)
                 i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
                 nb = self._cfg.blocks_per_slot
+                # One signature rule for every variant (adapter table
+                # right after params, adapter_idx right after the last
+                # scalar/positions, paged row/tables last) — the arg
+                # builders below (_decode_args/_prefill_args/warmup)
+                # follow the same rule, so adapter-enabled engines keep
+                # the compile-cache KEYS (and count) of base-only ones.
                 if key == "decode":
-                    if self._paged:
-                        kern = self._use_kernel
+                    kern = self._use_kernel if paged else False
 
-                        def _decode(p, toks, c, pos, tbl):
-                            return paged_decode_step(p, toks, c, pos, tbl,
-                                                     cfg, kernel=kern)
-                        exe = (jax.jit(_decode)
-                               .lower(p_sds, i32(s), c_sds, i32(s),
-                                      i32(s, nb)).compile())
-                    else:
-                        def _decode(p, toks, c, pos):
-                            return decode_step(p, toks, c, pos, cfg)
-                        exe = (jax.jit(_decode)
-                               .lower(p_sds, i32(s), c_sds, i32(s))
-                               .compile())
-                elif self._paged:
-                    t = key[1]
-
-                    def _paged_pf(p, toks, c, slot, length, wrow):
-                        c2, logits = paged_prefill(p, toks, c, slot, wrow,
-                                                   cfg, length=length)
-                        return c2, logits[length - 1]
-                    exe = (jax.jit(_paged_pf)
-                           .lower(p_sds, i32(t), c_sds, i32(), i32(),
-                                  i32(nb)).compile())
+                    def _decode(*a):
+                        it = iter(a)
+                        p = next(it)
+                        at = next(it) if has_ad else None
+                        toks, c, pos = next(it), next(it), next(it)
+                        aidx = next(it) if has_ad else None
+                        if paged:
+                            return paged_decode_step(
+                                p, toks, c, pos, next(it), cfg,
+                                kernel=kern, adapters=at,
+                                adapter_idx=aidx, lora=lcfg)
+                        return decode_step(p, toks, c, pos, cfg,
+                                           adapters=at, adapter_idx=aidx,
+                                           lora=lcfg)
+                    sds = ([p_sds] + ([a_sds] if has_ad else [])
+                           + [i32(s), c_sds, i32(s)]
+                           + ([i32(s)] if has_ad else [])
+                           + ([i32(s, nb)] if paged else []))
+                    exe = jax.jit(_decode).lower(*sds).compile()
                 else:
                     t = key[1]
 
-                    def _prefill(p, toks, c, slot, length):
-                        c2, logits = prefill(p, toks, c, slot, cfg,
-                                             length=length)
+                    def _prefill(*a):
+                        it = iter(a)
+                        p = next(it)
+                        at = next(it) if has_ad else None
+                        toks, c, slot, length = (next(it), next(it),
+                                                 next(it), next(it))
+                        aidx = next(it) if has_ad else None
+                        if paged:
+                            c2, logits = paged_prefill(
+                                p, toks, c, slot, next(it), cfg,
+                                length=length, adapters=at,
+                                adapter_idx=aidx, lora=lcfg)
+                        else:
+                            c2, logits = prefill(
+                                p, toks, c, slot, cfg, length=length,
+                                adapters=at, adapter_idx=aidx, lora=lcfg)
                         # Only the sampled row crosses back to the host —
                         # [vocab], not [T, vocab].
                         return c2, logits[length - 1]
-                    exe = (jax.jit(_prefill)
-                           .lower(p_sds, i32(t), c_sds, i32(), i32())
-                           .compile())
+                    sds = ([p_sds] + ([a_sds] if has_ad else [])
+                           + [i32(t), c_sds, i32(), i32()]
+                           + ([i32()] if has_ad else [])
+                           + ([i32(nb)] if paged else []))
+                    exe = jax.jit(_prefill).lower(*sds).compile()
                 self._compiled[key] = exe
                 with self._stats_lock:
                     self._compiled_ids.add(
@@ -428,21 +486,29 @@ class GenerationEngine(ReadinessMixin):
         warmed."""
         s = self._cfg.max_slots
         nb = self._cfg.blocks_per_slot
+        has_ad = self._adapters is not None
+        # All-trash tables/rows and all-base (-1) adapter indices:
+        # warmup scratch lands in the reserved block, pool and adapter
+        # table stay pristine.
+        args = [self._params]
+        if has_ad:
+            args.append(self._adapters.table())
+        args += [np.zeros((s,), np.int32), self._cache,
+                 np.full((s,), -1, np.int32)]
+        if has_ad:
+            args.append(np.full((s,), -1, np.int32))
         if self._paged:
-            # All-trash tables/rows: warmup scratch lands in the reserved
-            # block, the pool stays pristine.
-            out = self._compile("decode")(
-                self._params, np.zeros((s,), np.int32), self._cache,
-                np.full((s,), -1, np.int32),
-                np.full((s, nb), TRASH_BLOCK, np.int32))
-        else:
-            out = self._compile("decode")(
-                self._params, np.zeros((s,), np.int32), self._cache,
-                np.full((s,), -1, np.int32))
+            args.append(np.full((s, nb), TRASH_BLOCK, np.int32))
+        out = self._compile("decode")(*args)
         jax.block_until_ready(out)
         for t in self._buckets:
-            args = [self._params, np.zeros((t,), np.int32), self._cache,
-                    np.asarray(0, np.int32), np.asarray(1, np.int32)]
+            args = [self._params]
+            if has_ad:
+                args.append(self._adapters.table())
+            args += [np.zeros((t,), np.int32), self._cache,
+                     np.asarray(0, np.int32), np.asarray(1, np.int32)]
+            if has_ad:
+                args.append(np.asarray(-1, np.int32))
             if self._paged:
                 args.append(np.full((nb,), TRASH_BLOCK, np.int32))
             out = self._compile(("prefill", t))(*args)
@@ -456,18 +522,24 @@ class GenerationEngine(ReadinessMixin):
                max_new_tokens: Optional[int] = None,
                sampling: Optional[SamplingParams] = None,
                eos_id: Any = _DEFAULT,
-               deadline_ms: Optional[float] = None) -> GenerationHandle:
+               deadline_ms: Optional[float] = None,
+               adapter: Optional[str] = None) -> GenerationHandle:
         """Enqueue one prompt; returns a :class:`GenerationHandle`
         streaming the sampled tokens. Raises
-        :class:`ServerOverloadedError` when the admission queue is full,
+        :class:`ServerOverloadedError` when the admission queue is full
+        (or the tenant is over quota — reason ``tenant_quota``),
         :class:`ServerClosedError` after shutdown, ``ValueError`` on a
-        malformed or cache-overflowing prompt (all eagerly, in the
-        caller's thread).
+        malformed or cache-overflowing prompt, on an ``adapter`` that is
+        not resident, or on an ``adapter`` without a registry (all
+        eagerly, in the caller's thread).
 
         ``max_new_tokens`` is clamped to the cache room left after the
         prompt (the stream then finishes with reason ``"length"``);
         ``eos_id=None`` disables EOS for this request even when the
-        engine has a default.
+        engine has a default. ``adapter`` names the tenant's resident
+        LoRA fine-tune (None = base model); the stream pins the
+        adapter's table row for its whole lifetime, so an evict racing
+        a live stream is refused by the registry.
         """
         toks = np.asarray(tokens, np.int32)
         if toks.ndim != 1 or toks.size == 0:
@@ -499,25 +571,99 @@ class GenerationEngine(ReadinessMixin):
         eos = self._cfg.eos_id if eos_id is _DEFAULT else eos_id
         if deadline_ms is None:
             deadline_ms = self._cfg.default_deadline_ms
-        now = time.monotonic()
-        handle = GenerationHandle()
-        req = _GenRequest(
-            tokens=toks, max_new=max_new, sampling=sampling, eos=eos,
-            handle=handle, enqueued_at=now,
-            deadline_at=(None if deadline_ms is None
-                         else now + deadline_ms / 1e3),
-            rng=np.random.default_rng(sampling.seed))
-        handle.request = req
+        tenant = "base" if adapter is None else adapter
+        a_slot = -1
+        salt = b"\x00"      # base frame — see _GenRequest.prefix_salt
+        if adapter is not None:
+            if self._adapters is None:
+                raise ValueError(
+                    f"submit(adapter={adapter!r}) on an engine without an "
+                    f"AdapterRegistry — pass adapters= to "
+                    f"GenerationEngine")
+            # Retain BEFORE admission: the row must survive the queue
+            # wait too (an evict of a queued tenant would otherwise free
+            # the row its prefill is about to gather from).
+            a_slot = self._adapters.retain(adapter)   # ValueError if absent
+            # Generation read AFTER retain: the refcount blocks reloads,
+            # so the salt is stable for the stream's whole lifetime.
+            salt = (f"{adapter}\x00"
+                    f"{self._adapters.generation(adapter)}\x00".encode())
         try:
-            depth = self._queue.put(req)    # raises Closed / Overloaded
-        except ServerOverloadedError:
-            reason, detail = self._overload_reason(toks.size, max_new)
-            self._metrics.on_overload(reason)
-            raise ServerOverloadedError(
-                f"request queue full ({self._cfg.max_queue}); "
-                f"{reason}: {detail}") from None
+            self._tenant_admit(tenant)     # raises over-quota
+            now = time.monotonic()
+            handle = GenerationHandle()
+            req = _GenRequest(
+                tokens=toks, max_new=max_new, sampling=sampling, eos=eos,
+                handle=handle, enqueued_at=now,
+                deadline_at=(None if deadline_ms is None
+                             else now + deadline_ms / 1e3),
+                rng=np.random.default_rng(sampling.seed),
+                tenant=tenant, adapter=adapter, adapter_slot=a_slot,
+                prefix_salt=salt)
+            handle.request = req
+            try:
+                depth = self._queue.put(req)   # raises Closed / Overloaded
+            except ServerOverloadedError:
+                self._tenant_release(tenant)
+                reason, detail = self._overload_reason(toks.size, max_new)
+                self._metrics.on_overload(reason)
+                raise ServerOverloadedError(
+                    f"request queue full ({self._cfg.max_queue}); "
+                    f"{reason}: {detail}") from None
+            except ServerClosedError:
+                self._tenant_release(tenant)
+                raise
+        except BaseException:
+            if adapter is not None:
+                self._adapters.release(adapter)
+            raise
         self._metrics.on_submit(depth)
         return handle
+
+    def _tenant_admit(self, tenant: str) -> None:
+        """Count ``tenant``'s in-flight streams (queued + decoding) and
+        reject over quota — atomically, so two racing submits cannot
+        both squeeze under the cap. The rejection is its own reason
+        (``tenant_quota``) next to ``slots_full``/``blocks_exhausted``:
+        raising max_slots when one tenant is quota-bound fixes nothing."""
+        quota = (self._adapters.quota(tenant)
+                 if self._adapters is not None else None)
+        with self._tenant_lock:
+            inflight = self._tenant_inflight.get(tenant, 0)
+            if quota is not None and inflight >= quota:
+                self._metrics.on_overload("tenant_quota")
+                raise ServerOverloadedError(
+                    f"tenant {tenant!r} over quota: {inflight} streams "
+                    f"in flight >= quota {quota} — finish streams or "
+                    f"raise the tenant's quota")
+            self._tenant_inflight[tenant] = inflight + 1
+
+    def _tenant_label(self, req: _GenRequest) -> Optional[str]:
+        """The tenant stamped into metrics: only multi-tenant engines
+        (an AdapterRegistry attached) split by tenant — a base-only
+        engine must not grow ``hvd_tenant_*{tenant="base"}`` series or
+        a ``tenants`` /stats block it has no multi-tenant plane for."""
+        return req.tenant if self._adapters is not None else None
+
+    def _tenant_release(self, tenant: str) -> None:
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(tenant, 1) - 1
+            if n > 0:
+                self._tenant_inflight[tenant] = n
+            else:
+                self._tenant_inflight.pop(tenant, None)
+
+    def _req_done(self, req: _GenRequest) -> None:
+        """One request left the system (finished, failed, expired or
+        cancelled) — the single choke point for the tenant accounting:
+        drop its in-flight count and its adapter-row reference.
+        Idempotent (a drain timeout can walk the same request twice)."""
+        if req._done_accounted:
+            return
+        req._done_accounted = True
+        self._tenant_release(req.tenant)
+        if req.adapter is not None and self._adapters is not None:
+            self._adapters.release(req.adapter)
 
     def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
         """KV blocks a request reserves at admission: every position it
@@ -579,10 +725,44 @@ class GenerationEngine(ReadinessMixin):
             misses = snap["generation"]["prefix_misses_total"]
             snap["prefix_hit_rate"] = (hits / (hits + misses)
                                        if hits + misses else None)
+        if self._adapters is not None:
+            snap["adapters_resident"] = len(self._adapters.resident())
+            snap["adapter_table"] = self._adapters.gauges()
         with self._stats_lock:
             snap["compiled"] = sorted(map(str, self._compiled_ids))
         snap["max_queue"] = self._cfg.max_queue
         return snap
+
+    # -- multi-tenant adapter surface (fleet routing + lifecycle) ----------
+
+    @property
+    def adapters(self) -> Optional[AdapterRegistry]:
+        """This engine's registry (None = base-only engine)."""
+        return self._adapters
+
+    def adapter_names(self) -> Optional[Tuple[str, ...]]:
+        """Resident adapter names, or None when the engine carries no
+        registry — the residency signal the fleet router's
+        adapter-affine dispatch sorts on."""
+        if self._adapters is None:
+            return None
+        return self._adapters.resident()
+
+    def adapters_resident(self) -> Optional[int]:
+        """Resident-adapter count for ``/healthz`` (None = no registry)."""
+        names = self.adapter_names()
+        return None if names is None else len(names)
+
+    def load_adapter(self, name: str, adapter: Any,
+                     quota: Optional[int] = None) -> int:
+        """Hot-load ``adapter`` under ``name`` (the router's lazy-load
+        path on an affinity miss). Raises ``ValueError`` without a
+        registry or on a full table; never recompiles anything."""
+        if self._adapters is None:
+            raise ValueError(
+                "engine has no AdapterRegistry — pass adapters= to "
+                "GenerationEngine to serve adapters")
+        return self._adapters.load(name, adapter, quota=quota)
 
     def prom_collect(self):
         """This engine's ``(meta, samples)`` in Prometheus terms —
@@ -608,6 +788,11 @@ class GenerationEngine(ReadinessMixin):
         if self._closed:
             return
         self._closed = True
+        if self._adapters is not None:
+            # Unhook the metric-fold listener: a registry SHARED across
+            # replicas must not keep retired engines' metrics alive.
+            self._adapters.remove_evict_listener(
+                self._metrics.forget_tenant)
         if drain:
             self._queue.close()
         else:
@@ -624,6 +809,7 @@ class GenerationEngine(ReadinessMixin):
                 req.handle._fail(ServerClosedError(
                     "server shut down before execution"))
                 cancelled += 1
+            self._req_done(req)
         if cancelled:
             self._metrics.on_shutdown_cancel(cancelled)
 
@@ -643,6 +829,7 @@ class GenerationEngine(ReadinessMixin):
                         "server shut down before completion")
                     for req in self._held:
                         req.handle._fail(err)
+                        self._req_done(req)
                     self._held.clear()
                     self._fail_active(err)
                     return
@@ -679,6 +866,7 @@ class GenerationEngine(ReadinessMixin):
                     req.handle._fail(ServerOverloadedError(
                         "KV block pool cannot cover an admitted request "
                         "with the engine idle — admission accounting bug"))
+                    self._req_done(req)
             except Exception as e:  # noqa: BLE001 — deliver, don't die
                 self._fail_active(e)
 
@@ -686,6 +874,7 @@ class GenerationEngine(ReadinessMixin):
         for i, req in enumerate(self._slots):
             if req is not None:
                 req.handle._fail(exc)
+                self._req_done(req)
                 self._release_slot(i)
 
     def _release_slot(self, i: int) -> None:
@@ -694,6 +883,7 @@ class GenerationEngine(ReadinessMixin):
         reader ends) and trash-out its table row."""
         self._slots[i] = None
         self._positions[i] = -1
+        self._adapter_idx[i] = -1
         if self._paged:
             self._blocks.release(self._slot_blocks[i])
             self._slot_blocks[i] = []
@@ -707,7 +897,8 @@ class GenerationEngine(ReadinessMixin):
         matched)."""
         n_total = self._blocks_needed(req.tokens.size, req.max_new)
         while True:
-            hits = (self._blocks.lookup_prefix(req.tokens)
+            hits = (self._blocks.lookup_prefix(req.tokens,
+                                               salt=req.prefix_salt)
                     if self._cfg.prefix_reuse else [])
             hits = hits[:n_total]
             need = n_total - len(hits)
@@ -731,6 +922,7 @@ class GenerationEngine(ReadinessMixin):
             req.handle._fail(DeadlineExceededError(
                 f"deadline expired after "
                 f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
+            self._req_done(req)
             return "done"
         reservation = None
         row: List[int] = []
@@ -746,6 +938,16 @@ class GenerationEngine(ReadinessMixin):
             toks = np.zeros((bucket,), np.int32)
             toks[:length] = req.tokens
             exe = self._compile(("prefill", bucket))
+            args = [self._params]
+            if self._adapters is not None:
+                # The table read HERE is the hot-load boundary: a load
+                # committed before this admission is visible, one racing
+                # it lands at the next boundary — never mid-program.
+                args.append(self._adapters.table())
+            args += [toks, self._cache, np.asarray(slot, np.int32),
+                     np.asarray(length, np.int32)]
+            if self._adapters is not None:
+                args.append(np.asarray(req.adapter_slot, np.int32))
             if self._paged:
                 hits, fresh, n_total = reservation
                 row = hits + fresh
@@ -760,21 +962,15 @@ class GenerationEngine(ReadinessMixin):
                 n_full = length // self._cfg.block_size
                 if self._cfg.prefix_reuse and n_full > 0:
                     self._metrics.on_prefix(len(hits), n_full)
-                cache, last_logits = exe(
-                    self._params, toks, self._cache,
-                    np.asarray(slot, np.int32),
-                    np.asarray(length, np.int32), write_row)
-            else:
-                cache, last_logits = exe(
-                    self._params, toks, self._cache,
-                    np.asarray(slot, np.int32),
-                    np.asarray(length, np.int32))
+                args.append(write_row)
+            cache, last_logits = exe(*args)
             logits = np.asarray(last_logits)    # blocks
         except Exception as e:  # noqa: BLE001
             if reservation is not None:
                 hits, fresh, _ = reservation
                 self._blocks.release(hits + fresh)
             req.handle._fail(e)
+            self._req_done(req)
             return "done"
         self._cache = cache
         if self._paged and self._cfg.prefix_reuse:
@@ -783,12 +979,14 @@ class GenerationEngine(ReadinessMixin):
             # survives its first token.
             n_full = int(req.tokens.size) // self._cfg.block_size
             if n_full > 0:
-                self._blocks.register_prefix(req.tokens, row, n_full)
+                self._blocks.register_prefix(req.tokens, row, n_full,
+                                             salt=req.prefix_salt)
         req.t_first = time.monotonic()
-        self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3)
+        self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3,
+                                     tenant=self._tenant_label(req))
         tok = req.sample(logits)
         req.n_out = 1
-        self._metrics.on_tokens()
+        self._metrics.on_tokens(tenant=self._tenant_label(req))
         req.handle._emit(tok)
         reason = self._finish_reason(req, tok, next_pos=int(req.tokens.size))
         if reason:
@@ -799,6 +997,7 @@ class GenerationEngine(ReadinessMixin):
         self._slots[slot] = req
         self._positions[slot] = int(req.tokens.size)
         self._last[slot] = tok
+        self._adapter_idx[slot] = req.adapter_slot
         if self._paged:
             self._slot_blocks[slot] = row
             self._tables[slot] = read_row
@@ -806,14 +1005,15 @@ class GenerationEngine(ReadinessMixin):
 
     def _decode_once(self) -> None:
         t0 = time.monotonic()
+        args = [self._params]
+        if self._adapters is not None:
+            args.append(self._adapters.table())   # hot-load boundary
+        args += [self._last.copy(), self._cache, self._positions.copy()]
+        if self._adapters is not None:
+            args.append(self._adapter_idx.copy())
         if self._paged:
-            cache, logits = self._compile("decode")(
-                self._params, self._last.copy(), self._cache,
-                self._positions.copy(), self._tables.copy())
-        else:
-            cache, logits = self._compile("decode")(
-                self._params, self._last.copy(), self._cache,
-                self._positions.copy())
+            args.append(self._tables.copy())
+        cache, logits = self._compile("decode")(*args)
         logits_np = np.asarray(logits)          # blocks
         self._cache = cache
         exec_ms = (time.monotonic() - t0) * 1e3
@@ -825,7 +1025,7 @@ class GenerationEngine(ReadinessMixin):
             req = self._slots[i]
             tok = req.sample(logits_np[i])
             req.n_out += 1
-            self._metrics.on_tokens()
+            self._metrics.on_tokens(tenant=self._tenant_label(req))
             req.handle._emit(tok)
             self._positions[i] += 1
             self._last[i] = tok
@@ -847,16 +1047,20 @@ class GenerationEngine(ReadinessMixin):
         now = time.monotonic()
         gen_s = now - req.t_first
         ttft_ms = (req.t_first - req.enqueued_at) * 1e3
-        self._metrics.on_generation_end(req.n_out, gen_s)
+        self._metrics.on_generation_end(req.n_out, gen_s,
+                                        tenant=self._tenant_label(req))
         # queue_ms is the ADMISSION wait (enqueue → slot), not TTFT —
         # latency.queue_* must isolate queue pressure from prefill cost.
         self._metrics.on_response((now - req.enqueued_at) * 1e3,
                                   (req.t_admit - req.enqueued_at) * 1e3)
+        self._req_done(req)
         req.handle._finish({
             "tokens": list(req.handle._tokens),
             "finish_reason": reason,
             "n_tokens": req.n_out,
             "ttft_ms": ttft_ms,
+            "tenant": req.tenant,
+            "adapter": req.adapter,
             "tokens_per_sec": ((req.n_out - 1) / gen_s
                                if req.n_out > 1 and gen_s > 0 else None),
         })

@@ -106,6 +106,22 @@ class ServeMetrics:
             "hvd_generate_tokens_per_sec_user",
             "Per-stream decode rate (first token to last)",
             buckets=self.TPS_BUCKETS)
+        # Per-tenant series (the multi-tenant adapter plane): tenant= is
+        # the label rule — one series family, one label, bounded by the
+        # resident-adapter count + "base", never by user count.
+        self._h_tenant_ttft = self.registry.histogram(
+            "hvd_tenant_ttft_seconds",
+            "Per-tenant time to first token", labels=("tenant",))
+        self._h_tenant_tps = self.registry.histogram(
+            "hvd_tenant_tokens_per_sec_user",
+            "Per-tenant per-stream decode rate", labels=("tenant",),
+            buckets=self.TPS_BUCKETS)
+        self._c_tenant_generations = self.registry.counter(
+            "hvd_tenant_generations_total",
+            "Generation streams finished, by tenant", labels=("tenant",))
+        self._c_tenant_tokens = self.registry.counter(
+            "hvd_tenant_tokens_generated_total",
+            "Tokens sampled, by tenant", labels=("tenant",))
         self.requests_total = 0
         self.responses_total = 0
         self.rejected_overload = 0
@@ -116,6 +132,7 @@ class ServeMetrics:
         # when the pool is the binding constraint fixes nothing.
         self.rejected_slots_full = 0
         self.rejected_blocks_exhausted = 0
+        self.rejected_tenant_quota = 0
         self.expired_deadline = 0
         self.cancelled_shutdown = 0
         self.batches_total = 0
@@ -139,6 +156,11 @@ class ServeMetrics:
         self.prefix_misses_total = 0
         self.prefix_hit_blocks_total = 0
         self.prefix_lookup_blocks_total = 0
+        # Per-tenant recorders (multi-tenant adapters): lazily created on
+        # first tenant-stamped event. Engines without an AdapterRegistry
+        # never stamp one (GenerationEngine._tenant_label), so base-only
+        # engines keep an empty map and expose no hvd_tenant_* series.
+        self._tenants: Dict[str, Dict] = {}
 
     # -- producers ---------------------------------------------------------
 
@@ -148,13 +170,16 @@ class ServeMetrics:
             self.queue_depth = queue_depth
 
     def on_overload(self, reason: str = "slots_full") -> None:
-        """``reason`` is ``"slots_full"`` or ``"blocks_exhausted"`` —
-        the engine names the scarce resource; ``rejected_overload``
-        stays the total so existing dashboards keep reading."""
+        """``reason`` is ``"slots_full"``, ``"blocks_exhausted"`` or
+        ``"tenant_quota"`` — the engine names the scarce resource;
+        ``rejected_overload`` stays the total so existing dashboards
+        keep reading."""
         with self._lock:
             self.rejected_overload += 1
             if reason == "blocks_exhausted":
                 self.rejected_blocks_exhausted += 1
+            elif reason == "tenant_quota":
+                self.rejected_tenant_quota += 1
             else:
                 self.rejected_slots_full += 1
 
@@ -187,17 +212,36 @@ class ServeMetrics:
 
     # -- generation plane ----------------------------------------------------
 
-    def on_first_token(self, ttft_ms: float) -> None:
+    def _tenant(self, name: str) -> Dict:
+        """The per-tenant recorder bundle (caller holds ``self._lock``)."""
+        t = self._tenants.get(name)
+        if t is None:
+            t = self._tenants[name] = {
+                "generations_total": 0, "tokens_generated_total": 0,
+                "_ttft": _Reservoir(seed=5), "_tps": _Reservoir(seed=6)}
+        return t
+
+    def on_first_token(self, ttft_ms: float,
+                       tenant: Optional[str] = None) -> None:
         """Time-to-first-token: submit → the prefill's sampled token. The
         latency a generation user actually perceives as 'responsiveness'
-        — decode throughput is a separate number (below)."""
+        — decode throughput is a separate number (below). ``tenant``
+        additionally records the multi-tenant split."""
         with self._lock:
             self._ttft_ms.add(ttft_ms)
+            if tenant is not None:
+                self._tenant(tenant)["_ttft"].add(ttft_ms)
         self._h_ttft.observe(ttft_ms / 1e3)
+        if tenant is not None:
+            self._h_tenant_ttft.labels(tenant=tenant).observe(ttft_ms / 1e3)
 
-    def on_tokens(self, n: int = 1) -> None:
+    def on_tokens(self, n: int = 1, tenant: Optional[str] = None) -> None:
         with self._lock:
             self.tokens_generated_total += n
+            if tenant is not None:
+                self._tenant(tenant)["tokens_generated_total"] += n
+        if tenant is not None:
+            self._c_tenant_tokens.labels(tenant=tenant).inc(n)
 
     def on_prefix(self, hit_blocks: int, prompt_blocks: int) -> None:
         """One prefix-cache lookup at admission: ``hit_blocks`` of the
@@ -210,21 +254,62 @@ class ServeMetrics:
             self.prefix_hit_blocks_total += hit_blocks
             self.prefix_lookup_blocks_total += prompt_blocks
 
+    def forget_tenant(self, tenant: str) -> None:
+        """The tenant's adapter was evicted: fold its COUNTERS into the
+        one ``tenant="retired"`` aggregate and drop its recorders and
+        labeled series — the ``FleetMetrics.forget_replica`` discipline.
+        Tenant names churn over a process lifetime while table capacity
+        stays fixed, so without this every name ever served would keep
+        two reservoirs plus children on four ``hvd_tenant_*`` series
+        forever. Counters stay monotone through the fold; histogram
+        children terminate (scrapers treat disappearance as a normal
+        series end). No live stream can race this: evict only succeeds
+        at refcount 0, and queued streams hold refcounts."""
+        if tenant == "retired":
+            return
+        with self._lock:
+            t = self._tenants.pop(tenant, None)
+            if t is None:
+                return
+            r = self._tenant("retired")
+            r["generations_total"] += t["generations_total"]
+            r["tokens_generated_total"] += t["tokens_generated_total"]
+        for metric in (self._c_tenant_generations, self._c_tenant_tokens):
+            count = metric.labels(tenant=tenant).value
+            metric.remove(tenant=tenant)
+            if count > 0:
+                metric.labels(tenant="retired").inc(count)
+        self._h_tenant_ttft.remove(tenant=tenant)
+        self._h_tenant_tps.remove(tenant=tenant)
+
     def ttft_totals(self) -> Tuple[float, int]:
         """Cumulative ``(seconds_sum, count)`` of the TTFT histogram —
         the rate()-able pair the fleet autoscaler differences between
         polls (what a scraper's ``rate(_sum)/rate(_count)`` computes)."""
         return self._h_ttft.sum, self._h_ttft.count
 
-    def on_generation_end(self, n_tokens: int, seconds: float) -> None:
+    def on_generation_end(self, n_tokens: int, seconds: float,
+                          tenant: Optional[str] = None) -> None:
         """One finished request: records its tokens/sec-per-user (first
         token → last token — the per-stream decode rate, not aggregate
-        throughput; a busy batch lowers it while raising the aggregate)."""
+        throughput; a busy batch lowers it while raising the aggregate).
+        ``tenant`` additionally records the multi-tenant split."""
+        tps = ((n_tokens - 1) / seconds
+               if n_tokens > 1 and seconds > 0 else None)
         with self._lock:
             self.generations_total += 1
-            if n_tokens > 1 and seconds > 0:
-                self._tps_user.add((n_tokens - 1) / seconds)
-                self._h_tps.observe((n_tokens - 1) / seconds)
+            if tps is not None:
+                self._tps_user.add(tps)
+                self._h_tps.observe(tps)
+            if tenant is not None:
+                t = self._tenant(tenant)
+                t["generations_total"] += 1
+                if tps is not None:
+                    t["_tps"].add(tps)
+        if tenant is not None:
+            self._c_tenant_generations.labels(tenant=tenant).inc()
+            if tps is not None:
+                self._h_tenant_tps.labels(tenant=tenant).observe(tps)
 
     # -- export ------------------------------------------------------------
 
@@ -245,6 +330,7 @@ class ServeMetrics:
                 "rejected_overload": self.rejected_overload,
                 "rejected_slots_full": self.rejected_slots_full,
                 "rejected_blocks_exhausted": self.rejected_blocks_exhausted,
+                "rejected_tenant_quota": self.rejected_tenant_quota,
                 "expired_deadline": self.expired_deadline,
                 "cancelled_shutdown": self.cancelled_shutdown,
                 "batches_total": self.batches_total,
@@ -281,6 +367,21 @@ class ServeMetrics:
                     "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
                     "tokens_per_sec_user_p99": self._tps_user.quantile(0.99),
                 },
+                # Per-tenant split (multi-tenant adapters): the latency
+                # numbers a per-tenant SLO is written against. Empty dict
+                # until a tenant-stamped request finishes.
+                "tenants": {
+                    name: {
+                        "generations_total": t["generations_total"],
+                        "tokens_generated_total":
+                            t["tokens_generated_total"],
+                        "ttft_p50": t["_ttft"].quantile(0.50),
+                        "ttft_p99": t["_ttft"].quantile(0.99),
+                        "tokens_per_sec_user_p50":
+                            t["_tps"].quantile(0.50),
+                        "tokens_per_sec_user_p99":
+                            t["_tps"].quantile(0.99),
+                    } for name, t in sorted(self._tenants.items())},
             }
 
 
@@ -329,6 +430,8 @@ _TOP = {
                         "Prefix-cache lookup hit rate"),
     "block_size": ("hvd_kv_block_size", "gauge",
                    "Tokens per KV block (paged layout)"),
+    "adapters_resident": ("hvd_adapters_resident", "gauge",
+                          "LoRA adapters resident in the device table"),
 }
 
 _GENERATION = {
@@ -390,6 +493,11 @@ class FleetMetrics:
             labels=("direction",))
         for direction in ("grow", "shrink"):
             self._c_scale.labels(direction=direction)
+        # Adapter-plane series, LAZY: a fleet that never sees an adapter
+        # exposes neither (the gauge registers on the first non-None
+        # residency report, the counter on the first adapter dispatch).
+        self._g_adapters = None
+        self._c_adapter_dispatch = None
         self._replica_names: List[str] = []
         self._retired_names: set = set()
         # One lock over the dispatch-fold composite: read-value + remove
@@ -430,6 +538,45 @@ class FleetMetrics:
                 if "retired" not in self._replica_names:
                     self._replica_names.append("retired")
                 self._c_dispatch.labels(replica="retired").inc(count)
+
+    def set_adapters_resident(self, count: Optional[int]) -> None:
+        """Refresh ``hvd_fleet_adapters_resident`` — DISTINCT adapters
+        resident across the live membership (``None`` = no replica
+        carries a registry; the series stays absent until one does, so
+        adapter-free fleets expose nothing new)."""
+        if count is None and self._g_adapters is None:
+            return
+        if self._g_adapters is None:
+            self._g_adapters = self.registry.gauge(
+                "hvd_fleet_adapters_resident",
+                "Distinct LoRA adapters resident across live replicas")
+        self._g_adapters.set(int(count or 0))
+
+    def on_adapter_dispatch(self, outcome: str) -> None:
+        """One adapter-carrying dispatch:
+        ``hvd_fleet_adapter_dispatch_total{outcome=}`` — ``affine``
+        (the chosen replica already had the adapter resident) vs
+        ``miss`` (lazy-loaded on dispatch). A rising miss share means
+        the affinity plane is thrashing (table capacity too small for
+        the tenant working set)."""
+        if outcome not in ("affine", "miss"):
+            raise ValueError(
+                f"adapter dispatch outcome must be 'affine' or 'miss', "
+                f"got {outcome!r}")
+        if self._c_adapter_dispatch is None:
+            self._c_adapter_dispatch = self.registry.counter(
+                "hvd_fleet_adapter_dispatch_total",
+                "Adapter-carrying dispatches by affinity outcome",
+                labels=("outcome",))
+            for o in ("affine", "miss"):
+                self._c_adapter_dispatch.labels(outcome=o)
+        self._c_adapter_dispatch.labels(outcome=outcome).inc()
+
+    def adapter_dispatch_counts(self) -> Dict[str, int]:
+        if self._c_adapter_dispatch is None:
+            return {}
+        return {o: int(self._c_adapter_dispatch.labels(outcome=o).value)
+                for o in ("affine", "miss")}
 
     def on_scale(self, direction: str) -> None:
         if direction not in ("grow", "shrink"):
@@ -482,7 +629,8 @@ def collect_stats(snap: Dict, registry: MetricsRegistry,
         "counter", "Door rejections split by the scarce resource")
     for reason_key, reason in (("rejected_slots_full", "slots_full"),
                                ("rejected_blocks_exhausted",
-                                "blocks_exhausted")):
+                                "blocks_exhausted"),
+                               ("rejected_tenant_quota", "tenant_quota")):
         if reason_key in snap:
             samples.append(("hvd_rejected_total",
                             {**labels, "reason": reason},

@@ -137,16 +137,25 @@ class FleetRouter:
         (multi-process fleets); thread replicas leave it None.
       drain_timeout: seconds a drain-on-evict waits for the replica to
         finish its admitted streams before the handle is force-reaped.
+      adapter_source: optional ``adapter_source(name) -> adapter tree``
+        backing the adapter-affine dispatch's lazy-load path: a request
+        whose adapter is resident on NO ready replica is dispatched
+        least-load and the adapter hot-loaded there first (typically a
+        closure over ``parallel.checkpoint.restore_adapter`` — the
+        manifest-CRC walk then guards every lazy load). Without it, a
+        non-resident adapter is a ``ValueError`` naming the remedy.
     """
 
     def __init__(self, engines: Optional[List[Any]] = None, *,
                  factory: Optional[Callable[[str], Any]] = None,
                  initial: int = 0,
                  liveness_factory: Optional[Callable] = None,
-                 drain_timeout: float = 60.0):
+                 drain_timeout: float = 60.0,
+                 adapter_source: Optional[Callable[[str], Any]] = None):
         self._factory = factory
         self._liveness_factory = liveness_factory
         self._drain_timeout = drain_timeout
+        self._adapter_source = adapter_source
         self._lock = threading.Lock()
         self._metrics = FleetMetrics()
         self._replicas: List[ReplicaHandle] = []
@@ -161,6 +170,7 @@ class FleetRouter:
         # for the dispatch counter.
         self._retired_totals: Dict[str, float] = {}
         self._retired_gen_totals: Dict[str, float] = {}
+        self._retired_tenant_totals: Dict[str, Dict[str, float]] = {}
         # Fleet-wide concurrency high-water, sampled at dispatch and
         # stats boundaries. Summing per-replica peaks would add maxima
         # that never coincided (and the sum would DROP when a replica
@@ -333,6 +343,13 @@ class FleetRouter:
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     self._retired_gen_totals[key] = (
                         self._retired_gen_totals.get(key, 0) + v)
+            for tenant, tv in (snap.get("tenants") or {}).items():
+                base = self._retired_tenant_totals.setdefault(tenant, {})
+                for key in self._TENANT_SUM_KEYS:
+                    v = tv.get(key)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        base[key] = base.get(key, 0) + v
         self._metrics.forget_replica(handle.name)
 
     def _note_peak(self) -> None:
@@ -353,25 +370,116 @@ class FleetRouter:
             if active > self._peak_active:
                 self._peak_active = active
 
+    def adapters_resident(self) -> Optional[int]:
+        """DISTINCT adapters resident across live replicas (the
+        ``/healthz`` and fleet-line number), or None when no replica
+        carries a registry (an adapter-free fleet)."""
+        names: set = set()
+        any_registry = False
+        for h in self.replicas():
+            fn = getattr(h.engine, "adapter_names", None)
+            if not callable(fn):
+                continue
+            try:
+                res = fn()
+            except Exception:  # noqa: BLE001 — a dying replica counts 0
+                continue
+            if res is not None:
+                any_registry = True
+                names.update(res)
+        return len(names) if any_registry else None
+
     def _refresh_gauges(self) -> None:
         self._metrics.set_replicas(self.counts())
+        self._metrics.set_adapters_resident(self.adapters_resident())
 
     # -- dispatch ----------------------------------------------------------
 
+    @staticmethod
+    def _resident_names(handle: ReplicaHandle) -> Tuple[str, ...]:
+        """A replica's resident adapters (empty for engines without a
+        registry — they can never serve an adapter request)."""
+        fn = getattr(handle.engine, "adapter_names", None)
+        if not callable(fn):
+            return ()
+        try:
+            return tuple(fn() or ())
+        except Exception:  # noqa: BLE001 — a dying replica reads empty
+            return ()
+
+    def _lazy_load(self, handle: ReplicaHandle, adapter: str) -> None:
+        """The affinity-miss path: fetch the adapter from
+        ``adapter_source`` and hot-load it into ``handle`` before the
+        dispatch. Raises ``ValueError`` when this replica cannot take it
+        (no source, no registry, table full) — the dispatch loop then
+        fails over."""
+        if adapter in self._resident_names(handle):
+            return      # a concurrent submit already loaded it here
+        if self._adapter_source is None:
+            raise ValueError(
+                f"adapter {adapter!r} is not resident on any ready "
+                f"replica and the router has no adapter_source= to "
+                f"lazy-load it from — load it on a replica or pass "
+                f"adapter_source=")
+        load = getattr(handle.engine, "load_adapter", None)
+        if not callable(load):
+            raise ValueError(
+                f"replica {handle.name} cannot host adapters "
+                f"(engine has no load_adapter)")
+        # Propagate the tenant's quota from a replica that already hosts
+        # it: a lazy load must not mint a quota-free copy of the adapter
+        # (one saturated replica would otherwise let the tenant run
+        # unlimited streams through every replica it seeds).
+        quota = None
+        for other in self.replicas():
+            reg = getattr(other.engine, "adapters", None)
+            if reg is None:
+                continue
+            try:
+                if adapter in (reg.resident() or ()):
+                    quota = reg.quota(adapter)
+                    if quota is not None:
+                        break
+            except Exception:  # noqa: BLE001 — a dying replica has no say
+                continue
+        try:
+            load(adapter, self._adapter_source(adapter), quota=quota)
+        except RuntimeError:
+            # Raced a concurrent submit that loaded the same adapter
+            # (and already has a live stream refcounting its row, so the
+            # registry refused our redundant reload): it IS resident —
+            # the dispatch can proceed.
+            if adapter not in self._resident_names(handle):
+                raise
+
     def submit(self, *args, **kwargs):
         """Admit one request to the fleet: least-loaded READY replica
-        first, failing over across the ready set. Raises
+        first, failing over across the ready set. A request carrying
+        ``adapter=`` dispatches adapter-AFFINE: ready replicas that
+        already have the adapter resident come first (least-load
+        tiebreak unchanged — their KV/compile state is equally warm, so
+        load still orders within the resident set), the rest fall back
+        to least-load + lazy hot-load via ``adapter_source``. Raises
         :class:`ServerOverloadedError` only when EVERY ready replica
         rejected (or none is ready yet — a warming fleet is a retryable
         condition), :class:`ServerClosedError` once the router (or the
-        whole membership) is shut down. Returns whatever the replica's
-        ``submit`` returns (a :class:`~.generate.GenerationHandle` for
-        generation fleets, a ``Future`` for single-shot fleets)."""
+        whole membership) is shut down, ``ValueError`` when an adapter
+        is resident nowhere and cannot be lazy-loaded. Returns whatever
+        the replica's ``submit`` returns (a
+        :class:`~.generate.GenerationHandle` for generation fleets, a
+        ``Future`` for single-shot fleets)."""
         if self._closed:
             raise ServerClosedError("fleet router is shut down")
+        adapter = kwargs.get("adapter")
         snapshot = self.replicas()
-        ready = sorted((h for h in snapshot if h.state() == "ready"),
-                       key=lambda h: h.load())
+        ready = [h for h in snapshot if h.state() == "ready"]
+        resident: Dict[str, bool] = {}
+        if adapter is not None:
+            resident = {h.name: adapter in self._resident_names(h)
+                        for h in ready}
+            ready.sort(key=lambda h: (not resident[h.name], h.load()))
+        else:
+            ready.sort(key=lambda h: h.load())
         if not ready:
             warming = sum(1 for h in snapshot if h.state() == "warming")
             if warming:
@@ -389,20 +497,67 @@ class FleetRouter:
             raise ServerClosedError(
                 "fleet has no live replicas (all drained or dead)")
         last: Optional[BaseException] = None
+        hosting_error: Optional[ValueError] = None
+        saw_backpressure = False
+        lazy_loaded = False
         for h in ready:
+            if adapter is not None and not resident.get(h.name):
+                if lazy_loaded:
+                    # At most ONE lazy load per dispatch: a burst that
+                    # overloads the freshly-loaded replica must read as
+                    # retryable overload, not replicate the adapter into
+                    # every table on the failover walk (rows are never
+                    # auto-evicted — proliferation would turn transient
+                    # backpressure into permanently full tables). Spread
+                    # stays demand-driven: each retry may seed one more
+                    # replica while the resident set stays saturated.
+                    continue
+                try:
+                    self._lazy_load(h, adapter)
+                    lazy_loaded = True
+                except ValueError as e:
+                    # This replica can't take the adapter (no source /
+                    # no registry / table full): fail over.
+                    last = hosting_error = e
+                    continue
             try:
                 out = h.engine.submit(*args, **kwargs)
             except ServerOverloadedError as e:
                 last = e
+                saw_backpressure = True
                 continue
             except ServerClosedError as e:
                 # Raced a drain decision between the snapshot and the
                 # submit: that replica's door is shut, not the fleet's.
                 last = e
+                saw_backpressure = True
+                continue
+            except ValueError as e:
+                if adapter is None:
+                    raise
+                # An adapter submit can lose an evict race: the adapter
+                # was resident when this loop snapshotted residency, and
+                # gone by the time submit retained it. Other replicas may
+                # still host it — fail over instead of erroring the
+                # request terminally. (A genuinely malformed request
+                # raises the same ValueError on EVERY replica with no
+                # backpressure seen, and surfaces below unchanged.)
+                last = hosting_error = e
                 continue
             self._metrics.on_dispatch(h.name)
+            if adapter is not None:
+                self._metrics.on_adapter_dispatch(
+                    "affine" if resident.get(h.name) else "miss")
             self._note_peak()
             return out
+        if adapter is not None and hosting_error is not None \
+                and not saw_backpressure:
+            # EVERY ready replica failed to even HOST the adapter — a
+            # config problem, not backpressure; retrying would never
+            # help. (If any hosting-capable replica merely rejected on
+            # load, the condition IS retryable — fall through to the
+            # overload below.)
+            raise hosting_error
         raise ServerOverloadedError(
             f"all {len(ready)} ready replicas rejected the request "
             f"(last: {last}) — grow the fleet or shed load")
@@ -508,7 +663,8 @@ class FleetRouter:
     # gauges (queue depth, slots) reflect live membership only.
     _COUNTER_KEYS = ("requests_total", "responses_total",
                      "rejected_overload", "rejected_slots_full",
-                     "rejected_blocks_exhausted", "expired_deadline",
+                     "rejected_blocks_exhausted", "rejected_tenant_quota",
+                     "expired_deadline",
                      "cancelled_shutdown", "batches_total",
                      "batch_rows_total", "batch_live_rows_total")
     # (peak_active_slots is NOT summed: the fleet peak is the router's
@@ -518,6 +674,11 @@ class FleetRouter:
     _GEN_SUM_KEYS = ("generations_total", "tokens_generated_total",
                      "prefix_hits_total", "prefix_misses_total",
                      "prefix_hit_blocks_total", "prefix_lookup_blocks_total")
+    # Per-tenant counters summed across replicas (+ retired baselines —
+    # same monotonicity rule); tenant percentile fields cannot be summed
+    # and stay in the nested per-replica snapshots (scrape the
+    # hvd_tenant_* histograms for fleet-wide tenant quantiles).
+    _TENANT_SUM_KEYS = ("generations_total", "tokens_generated_total")
 
     def stats(self) -> Dict:
         """The fleet ``/stats`` snapshot: aggregate counters at the top
@@ -542,6 +703,8 @@ class FleetRouter:
         with self._lock:
             retired = dict(self._retired_totals)
             retired_gen = dict(self._retired_gen_totals)
+            retired_tenants = {t: dict(v) for t, v in
+                               self._retired_tenant_totals.items()}
         for key in self._SUM_KEYS:
             vals = [p.get(key) for p in per.values()
                     if isinstance(p.get(key), (int, float))]
@@ -574,13 +737,34 @@ class FleetRouter:
             "prefix_misses_total", 0)
         snap["prefix_hit_rate"] = (hits / (hits + misses)
                                    if hits + misses else None)
+        # Per-tenant counter aggregates (multi-tenant adapters): summed
+        # across live replicas plus retired baselines, keyed exactly as
+        # one engine's snapshot keys them.
+        tenants: Dict[str, Dict[str, float]] = {
+            t: dict(v) for t, v in retired_tenants.items()}
+        for p in per.values():
+            for name, tv in (p.get("tenants") or {}).items():
+                agg = tenants.setdefault(name, {})
+                for key in self._TENANT_SUM_KEYS:
+                    v = tv.get(key)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        agg[key] = agg.get(key, 0) + v
+        if tenants:
+            snap["tenants"] = tenants
+        k = self.adapters_resident()
+        if k is not None:
+            snap["adapters_resident"] = k
         snap["replicas"] = per
+        adapter_dispatch = self._metrics.adapter_dispatch_counts()
         snap["fleet"] = {
             "replicas": len(per),
             "states": states,
             **{f"n_{s}": n for s, n in self.counts().items()},
             "dispatch_total": self._metrics.dispatch_counts(),
             "scale_events": self._metrics.scale_counts(),
+            **({"adapter_dispatch": adapter_dispatch}
+               if adapter_dispatch else {}),
         }
         return snap
 

@@ -122,6 +122,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if callable(fleet_health):
                     body["replicas"] = fleet_health()
                     break
+            # Adapter-table residency (multi-tenant serving): how many
+            # fine-tunes this endpoint can serve right now. Engines and
+            # routers both answer adapters_resident(); None (no registry
+            # anywhere) keeps the field out of the body.
+            for eng in (primary, self.gen_engine):
+                fn = getattr(eng, "adapters_resident", None)
+                if callable(fn):
+                    k = fn()
+                    if k is not None:
+                        body["adapters_resident"] = int(k)
+                        break
             self._reply(200 if ready else 503, body)
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
@@ -148,6 +159,10 @@ class _Handler(BaseHTTPRequestHandler):
                 top_k=int(req.get("top_k", 0)),
                 seed=int(req.get("seed", 0)))
             kw = {}
+            if req.get("adapter") is not None:
+                # Multi-tenant serving: the tenant's resident LoRA
+                # fine-tune (docs/inference.md "Multi-tenant adapters").
+                kw["adapter"] = str(req["adapter"])
             if req.get("max_new_tokens") is not None:
                 kw["max_new_tokens"] = int(req["max_new_tokens"])
             if "eos" in req:

@@ -1,0 +1,717 @@
+"""Multi-tenant LoRA adapter tests: model-layer delta math (merged-weight
+parity, base-row bit-identity), the AdapterRegistry load/evict/refcount
+discipline, engine-level mixed-batch bit-identity with the compile-cache
+pinned at the base-only count, per-tenant quota rejection with its own
+reason, manifest-CRC-verified adapter restore, and adapter-affine fleet
+routing on fake engines.
+
+Budget-conscious (tier-1 sits ~430s of the 870s cap): the same tiny
+module-scoped model as tests/test_paged_kv.py, every prompt in ONE
+prefill bucket, engines shared through module fixtures wherever a test
+only reads streams; the open-loop digest drills and the hot-evict-under-
+traffic leg live in ci.sh, not here.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serve
+from horovod_tpu.exceptions import (CheckpointCorruptError,
+                                    ServerOverloadedError)
+from horovod_tpu.parallel.checkpoint import restore_adapter, save_adapter
+from horovod_tpu.parallel.lora import (LoraConfig, adapter_bytes,
+                                       check_adapter, init_adapter,
+                                       stack_adapters, target_shapes)
+from horovod_tpu.parallel.transformer import (TransformerConfig,
+                                              decode_step, init_kv_cache,
+                                              init_params, prefill)
+from horovod_tpu.serve.adapters import AdapterRegistry
+from horovod_tpu.serve.engine import ReadinessMixin
+from horovod_tpu.serve.router import FleetRouter
+
+CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+# 9 tokens → the 16 bucket for every engine in this module (one decode +
+# one prefill compile per engine, as in test_paged_kv.py).
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def lora_setup(model):
+    cfg, _ = model
+    lora = LoraConfig(rank=2)
+    ads = {f"a{i}": init_adapter(jax.random.PRNGKey(1 + i), cfg, lora,
+                                 b_scale=0.5)
+           for i in range(2)}
+    return lora, ads
+
+
+def _engine(params, cfg, adapters=None, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_len", 16)
+    kw.setdefault("default_max_new_tokens", 6)
+    return serve.GenerationEngine(params, cfg,
+                                  serve.GenerationConfig(**kw),
+                                  adapters=adapters)
+
+
+@pytest.fixture(scope="module")
+def engines(model, lora_setup):
+    """One plain engine + one adapter engine sharing a registry with
+    a0/a1 resident — shared by every stream-reading test (results are
+    deterministic per request; counter-exact tests build their own)."""
+    cfg, params = model
+    lora, ads = lora_setup
+    reg = AdapterRegistry(cfg, lora, capacity=3)
+    for name, tree in sorted(ads.items()):
+        reg.load(name, tree)
+    engs = {"plain": _engine(params, cfg),
+            "adapter": _engine(params, cfg, adapters=reg)}
+    yield engs
+    for e in engs.values():
+        e.shutdown()
+
+
+class TestLoraConfigAndTrees:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="rank"):
+            LoraConfig(rank=0)
+        with pytest.raises(ValueError, match="alpha"):
+            LoraConfig(alpha=0)
+        with pytest.raises(ValueError, match="target"):
+            LoraConfig(targets=())
+        with pytest.raises(ValueError, match="wq_typo"):
+            LoraConfig(targets=("wq_typo",))
+        assert LoraConfig(rank=4, alpha=8).scaling == 2.0
+
+    def test_check_adapter_names_culprit(self, model, lora_setup):
+        cfg, _ = model
+        lora, ads = lora_setup
+        check_adapter(ads["a0"], cfg, lora)         # fits
+        with pytest.raises(ValueError, match="layers"):
+            check_adapter({"layers": ads["a0"]["layers"][:1]}, cfg, lora)
+        bad = {"layers": [dict(l) for l in ads["a0"]["layers"]]}
+        bad["layers"][1] = dict(bad["layers"][1])
+        bad["layers"][1]["wo"] = {"a": np.zeros((3, 2), np.float32),
+                                  "b": bad["layers"][1]["wo"]["b"]}
+        with pytest.raises(ValueError, match="layer 1 target 'wo'"):
+            check_adapter(bad, cfg, lora)
+        # wrong inner keys (a foreign export) name the culprit too —
+        # never a bare KeyError
+        bad["layers"][1]["wo"] = {"A": np.zeros((2, 2)),
+                                  "B": np.zeros((2, 2))}
+        with pytest.raises(ValueError, match="layer 1 target 'wo'"):
+            check_adapter(bad, cfg, lora)
+        # memory math: rank-r delta bytes = 4·r·Σ(d_in + d_out) per layer
+        shapes = target_shapes(cfg)
+        want = cfg.n_layers * sum(
+            4 * lora.rank * (shapes[t][0] + shapes[t][1])
+            for t in lora.targets)
+        assert adapter_bytes(cfg, lora) == want
+
+    def test_adapters_require_lora_config(self, model, lora_setup):
+        cfg, params = model
+        _, ads = lora_setup
+        table = stack_adapters([ads["a0"]])
+        cache = init_kv_cache(cfg, 2, 16)
+        with pytest.raises(ValueError, match="lora="):
+            prefill(params, np.asarray(PROMPT, np.int32), cache, 0, cfg,
+                    adapters=table, adapter_idx=0)
+
+
+class TestModelLayer:
+    def test_merged_parity_and_base_bit_identity(self, model, lora_setup):
+        """The two numerical contracts in one pass: (a) adapter_idx=0
+        matches a base-path run over MERGED weights W + (alpha/r)·A@B
+        (allclose — association order differs), and (b) adapter_idx=-1
+        rows are BIT-identical to a run without any adapter table (the
+        where-select guarantee, not y + 0.0)."""
+        cfg, params = model
+        lora, ads = lora_setup
+        table = stack_adapters([ads["a0"], ads["a1"]])
+        toks = np.asarray(PROMPT[:6], np.int32)
+        cache = init_kv_cache(cfg, 2, 16)
+        merged = {"embed": params["embed"], "lnf": params["lnf"],
+                  "layers": []}
+        for li, layer in enumerate(params["layers"]):
+            nl = dict(layer)
+            for t, pair in ads["a0"]["layers"][li].items():
+                nl[t] = layer[t] + lora.scaling * (pair["a"] @ pair["b"])
+            merged["layers"].append(nl)
+
+        # Two compiled programs per phase, each reused twice (adapter_idx
+        # is a traced arg — the same no-new-compile property the engine
+        # rides): base/merged share one, -1/0 table runs share the other.
+        pf = jax.jit(lambda p, t, c: prefill(p, t, c, 0, cfg))
+        pf_a = jax.jit(lambda p, t, c, i: prefill(
+            p, t, c, 0, cfg, adapters=table, adapter_idx=i, lora=lora))
+        c_b, l_b = pf(params, toks, cache)
+        c_n, l_n = pf_a(params, toks, cache, -1)
+        np.testing.assert_array_equal(np.asarray(l_n), np.asarray(l_b))
+        c_m, l_m = pf(merged, toks, cache)
+        c_t, l_t = pf_a(params, toks, cache, 0)
+        np.testing.assert_allclose(np.asarray(l_t), np.asarray(l_m),
+                                   rtol=2e-5, atol=1e-5)
+        assert not np.array_equal(np.asarray(l_t), np.asarray(l_b))
+
+        last = np.array([7, 0], np.int32)
+        pos = np.array([6, -1], np.int32)
+        dec = jax.jit(lambda p, l, c, q: decode_step(p, l, c, q, cfg))
+        dec_a = jax.jit(lambda p, l, c, q, i: decode_step(
+            p, l, c, q, cfg, adapters=table, adapter_idx=i, lora=lora))
+        _, d_b = dec(params, last, c_b, pos)
+        _, d_n = dec_a(params, last, c_n, pos,
+                       np.array([-1, -1], np.int32))
+        np.testing.assert_array_equal(np.asarray(d_n), np.asarray(d_b))
+        _, d_m = dec(merged, last, c_m, pos)
+        _, d_t = dec_a(params, last, c_t, pos,
+                       np.array([0, -1], np.int32))
+        np.testing.assert_allclose(np.asarray(d_t)[0], np.asarray(d_m)[0],
+                                   rtol=2e-5, atol=1e-5)
+        # the mixed row 1 (base) is bit-equal to the no-table run's row 1
+        np.testing.assert_array_equal(np.asarray(d_t)[1],
+                                      np.asarray(d_b)[1])
+
+
+class TestAdapterRegistry:
+    def test_load_evict_refcount_quota_drill(self, model, lora_setup):
+        cfg, _ = model
+        lora, ads = lora_setup
+        reg = AdapterRegistry(cfg, lora, capacity=2)
+        assert reg.resident() == ()
+        i0 = reg.load("a0", ads["a0"], quota=3)
+        assert reg.index_of("a0") == i0 and reg.quota("a0") == 3
+        # the table row carries the adapter's bytes
+        row = reg.table()["layers"][0]["wqkv"]["a"][i0]
+        np.testing.assert_array_equal(
+            np.asarray(row), np.asarray(ads["a0"]["layers"][0]["wqkv"]["a"]))
+        reg.load("a1", ads["a1"])
+        with pytest.raises(ValueError, match="full"):
+            reg.load("a2", ads["a0"])
+        # refcount discipline: retained rows refuse evict AND hot-reload
+        assert reg.retain("a0") == i0
+        with pytest.raises(RuntimeError, match="referenced"):
+            reg.evict("a0")
+        with pytest.raises(RuntimeError, match="referenced"):
+            reg.load("a0", ads["a1"])
+        reg.release("a0")
+        with pytest.raises(RuntimeError, match="unretained"):
+            reg.release("a0")
+        reg.evict("a0")
+        with pytest.raises(ValueError, match="resident"):
+            reg.retain("a0")
+        with pytest.raises(ValueError, match="no adapter"):
+            reg.evict("a0")
+        reg.load("a2", ads["a0"])               # freed row reused
+        assert reg.resident() == ("a1", "a2")
+        # quotas: "base" is a quotable tenant, evict drops the quota
+        reg.set_quota("base", 2)
+        assert reg.quota("base") == 2
+        reg.set_quota("base", None)
+        assert reg.quota("base") is None
+        with pytest.raises(ValueError, match="quota"):
+            reg.set_quota("a1", 0)
+        g = reg.gauges()
+        assert g["capacity"] == 2 and g["resident"] == 2
+        assert g["loads_total"] == 3 and g["evictions_total"] == 1
+
+    def test_adapter_names_are_validated(self, model, lora_setup):
+        """One identifier grammar everywhere a name travels (paths,
+        labels, prefix-reuse salts): a name embedding NUL + digits could
+        otherwise forge another (name, generation) salt and alias two
+        tenants' cached K/V."""
+        from horovod_tpu.parallel.checkpoint import adapter_path
+        cfg, _ = model
+        lora, ads = lora_setup
+        reg = AdapterRegistry(cfg, lora, capacity=1)
+        for bad in ("", "a\x001", "a/b", ".hidden", "a" * 129, 7,
+                    "base", "retired"):
+            with pytest.raises(ValueError, match="adapter name"):
+                reg.load(bad, ads["a0"])
+            with pytest.raises(ValueError, match="adapter name"):
+                adapter_path("/tmp", bad)
+        # "base" stays quotable as the adapter-less traffic class even
+        # though no adapter may claim the name
+        reg.set_quota("base", 2)
+        assert reg.quota("base") == 2
+        assert reg.load("Ok-name.v2", ads["a0"]) == 0
+
+
+class TestEngineMultiTenant:
+    def test_mixed_batch_bit_identity(self, engines):
+        """THE acceptance contract: each tenant's stream is bit-identical
+        alone, in a mixed-adapter batch, and interleaved with base
+        traffic — and base traffic through an adapter-enabled engine is
+        bit-identical to a plain engine's."""
+        plain, eng = engines["plain"], engines["adapter"]
+        base_ref = plain.generate(PROMPT, timeout=60)
+        alone = {t: eng.generate(PROMPT, adapter=t, timeout=60)
+                 for t in ("a0", "a1")}
+        assert alone["a0"]["tokens"] != base_ref["tokens"]
+        assert alone["a0"]["tokens"] != alone["a1"]["tokens"]
+        assert eng.generate(PROMPT, timeout=60)["tokens"] \
+            == base_ref["tokens"]
+        n0 = len(eng._compiled)
+        hs = [eng.submit(PROMPT, adapter="a0"),
+              eng.submit(PROMPT, adapter="a1"),
+              eng.submit(PROMPT)]
+        res = [h.result(60) for h in hs]
+        assert res[0]["tokens"] == alone["a0"]["tokens"]
+        assert res[1]["tokens"] == alone["a1"]["tokens"]
+        assert res[2]["tokens"] == base_ref["tokens"]
+        assert res[0]["tenant"] == "a0" and res[2]["tenant"] == "base"
+        # compile-cache pin: the mixed batch compiled NOTHING new, and
+        # the adapter engine's cache matches the plain engine's exactly
+        assert len(eng._compiled) == n0
+        assert set(eng._compiled_ids) == set(plain._compiled_ids)
+
+    def test_seeded_sampling_bit_identity(self, engines):
+        samp = serve.SamplingParams(temperature=0.7, top_k=8, seed=11)
+        eng = engines["adapter"]
+        alone = eng.generate(PROMPT, adapter="a0", sampling=samp,
+                             timeout=60)
+        hs = [eng.submit(PROMPT, adapter="a0", sampling=samp),
+              eng.submit(PROMPT, adapter="a1", sampling=samp)]
+        assert hs[0].result(60)["tokens"] == alone["tokens"]
+
+    def test_quota_rejection_split_and_release(self, model, lora_setup):
+        """Over-quota rejection is its own reason (tenant_quota) next to
+        slots_full/blocks_exhausted, counted in /stats and the labeled
+        hvd_rejected_total — own PAGED engine (counter-exact, and it
+        exercises the paged adapter arg path)."""
+        cfg, params = model
+        lora, ads = lora_setup
+        reg = AdapterRegistry(cfg, lora, capacity=2)
+        reg.load("a0", ads["a0"], quota=1)
+        eng = _engine(params, cfg, adapters=reg, kv_layout="paged",
+                      block_size=4)
+        try:
+            h1 = eng.submit(PROMPT, adapter="a0", max_new_tokens=8)
+            with pytest.raises(ServerOverloadedError, match="quota"):
+                eng.submit(PROMPT, adapter="a0")
+            assert h1.result(60)["n_tokens"] == 8
+            # quota released with the stream; base stays unlimited
+            assert eng.generate(PROMPT, adapter="a0",
+                                timeout=60)["n_tokens"] >= 1
+            snap = eng.stats()
+            assert snap["rejected_tenant_quota"] == 1
+            assert snap["rejected_overload"] == 1
+            assert snap["rejected_slots_full"] == 0
+            assert snap["adapter_table"]["refcounts"]["a0"] == 0
+            meta, samples = eng.prom_collect()
+            quota_samples = [v for name, labels, v in samples
+                             if name == "hvd_rejected_total"
+                             and labels.get("reason") == "tenant_quota"]
+            assert quota_samples == [1.0]
+        finally:
+            eng.shutdown()
+
+    def test_prefix_reuse_is_tenant_salted(self, model, lora_setup,
+                                           engines):
+        """A prompt's cached K/V is a function of the weights that wrote
+        it: tenant a0's registered prefix must NOT serve base (or other
+        tenants') identical token prefixes, and a reloaded adapter under
+        the same name must not hit its predecessor's K/V (the salt
+        carries the load generation)."""
+        from horovod_tpu.parallel.kv_blocks import BlockManager
+        bm = BlockManager(4, 4)
+        toks = np.arange(4, dtype=np.int32)
+        blocks = bm.alloc(1)
+        bm.register_prefix(toks, blocks, 1, salt=b"t1\x00")
+        assert bm.lookup_prefix(toks) == []          # base: different salt
+        assert bm.lookup_prefix(toks, salt=b"t1\x00") == blocks
+        # The framing attack: a 4-aligned adapter salt spelled as int32
+        # token values must NOT let base traffic hit the adapter's
+        # blocks — the engine's base frame (b"\x00") can never byte-
+        # equal a key whose salt starts with a name character.
+        name_salt = b"abcdefghijklm\x001\x00"        # 16 bytes, 4-aligned
+        bm2 = BlockManager(6, 4)
+        t_blocks = bm2.alloc(1)
+        tenant_toks = np.array([5, 6, 7, 8], np.int32)
+        bm2.register_prefix(tenant_toks, t_blocks, 1, salt=name_salt)
+        attack = np.concatenate([np.frombuffer(name_salt, "<i4"),
+                                 tenant_toks]).astype(np.int32)
+        assert bm2.lookup_prefix(attack, salt=b"\x00") == []
+        # ... and the unframed b"" salt WOULD alias (the bug the frame
+        # closes): once the attacker's own first block is registered,
+        # the chain walk crosses into the tenant's registered block.
+        a_blk = bm2.alloc(1)
+        bm2.register_prefix(attack, a_blk, 1, salt=b"")
+        assert bm2.lookup_prefix(attack, salt=b"") == [a_blk[0],
+                                                       t_blocks[0]]
+        assert bm2.lookup_prefix(attack, salt=b"\x00") == []
+
+        cfg, params = model
+        lora, ads = lora_setup
+        reg = AdapterRegistry(cfg, lora, capacity=2)
+        reg.load("a0", ads["a0"])
+        eng = _engine(params, cfg, adapters=reg, kv_layout="paged",
+                      block_size=4, prefix_reuse=True)
+        try:
+            a0_first = eng.generate(PROMPT, adapter="a0", timeout=60)
+            # base with the SAME token prefix: must MISS a0's registered
+            # blocks and produce the plain engine's stream bit-exactly
+            base = eng.generate(PROMPT, timeout=60)
+            ref = engines["plain"].generate(PROMPT, timeout=60)
+            assert base["tokens"] == ref["tokens"], \
+                "base stream read a tenant's adapter-delta'd KV prefix"
+            # each identity hits its OWN prefix: streams unchanged
+            assert eng.generate(PROMPT, timeout=60)["tokens"] \
+                == ref["tokens"]
+            a0_hit = eng.generate(PROMPT, adapter="a0", timeout=60)
+            assert a0_hit["tokens"] == a0_first["tokens"]
+            snap = eng.stats()
+            assert snap["generation"]["prefix_misses_total"] == 2
+            assert snap["generation"]["prefix_hits_total"] == 2
+            # hot-reload under the SAME name: new generation, new salt —
+            # the first request after the reload must MISS, never attend
+            # over the predecessor's K/V
+            hits_before = eng.stats()["generation"]["prefix_hits_total"]
+            reg.evict("a0")
+            reg.load("a0", ads["a1"])       # different weights, same name
+            reloaded = eng.generate(PROMPT, adapter="a0", timeout=60)
+            snap = eng.stats()
+            assert snap["generation"]["prefix_hits_total"] == hits_before
+            assert reloaded["tokens"] != a0_first["tokens"]
+        finally:
+            eng.shutdown()
+
+    def test_unknown_adapter_and_no_registry_errors(self, engines):
+        with pytest.raises(ValueError, match="load"):
+            engines["adapter"].submit(PROMPT, adapter="nope")
+        with pytest.raises(ValueError, match="AdapterRegistry"):
+            engines["plain"].submit(PROMPT, adapter="a0")
+        assert engines["plain"].adapter_names() is None
+        assert engines["plain"].adapters_resident() is None
+
+    def test_evict_folds_tenant_metric_state(self, model, lora_setup):
+        """Tenant churn is bounded: evicting an adapter folds its
+        counters into tenant="retired" and drops its recorders and
+        labeled series (the FleetMetrics.forget_replica discipline) —
+        counters stay monotone, reservoirs don't accumulate forever."""
+        cfg, params = model
+        lora, ads = lora_setup
+        reg = AdapterRegistry(cfg, lora, capacity=2)
+        reg.load("a0", ads["a0"])
+        eng = _engine(params, cfg, adapters=reg)
+        try:
+            r = eng.generate(PROMPT, adapter="a0", timeout=60)
+            gens = eng.stats()["tenants"]["a0"]["generations_total"]
+            assert gens == 1
+            reg.evict("a0")
+            snap = eng.stats()
+            assert "a0" not in snap["tenants"]
+            assert snap["tenants"]["retired"]["generations_total"] == 1
+            assert snap["tenants"]["retired"]["tokens_generated_total"] \
+                == r["n_tokens"]
+            text = eng.prom_metrics()
+            assert 'tenant="a0"' not in text
+            assert ('hvd_tenant_generations_total{engine="generate",'
+                    'tenant="retired"} 1') in text
+        finally:
+            eng.shutdown()
+
+    def test_tenant_stats_metrics_and_healthz(self, engines):
+        eng = engines["adapter"]
+        # Self-sufficient traffic (the shared engines fixture makes no
+        # traffic guarantee — this test must pass in isolation too).
+        for t in (None, "a0", "a1"):
+            eng.generate(PROMPT, adapter=t, timeout=60)
+        snap = eng.stats()
+        assert snap["adapters_resident"] == 2
+        assert snap["adapter_table"]["names"] == ["a0", "a1"]
+        for t in ("a0", "a1", "base"):
+            assert snap["tenants"][t]["generations_total"] >= 1
+            assert snap["tenants"][t]["ttft_p50"] is not None
+        text = eng.prom_metrics()
+        assert 'hvd_tenant_ttft_seconds_bucket' in text
+        assert 'tenant="a0"' in text and 'tenant="base"' in text
+        assert 'hvd_adapters_resident' in text
+        assert text.count('# TYPE hvd_tenant_ttft_seconds ') == 1
+        with serve.HttpServer(generate=eng) as srv:
+            url = f"http://{srv.host}:{srv.port}/healthz"
+            try:
+                resp = urllib.request.urlopen(url, timeout=5)
+                body = json.loads(resp.read())
+            except urllib.error.HTTPError as e:   # 503 while unwarmed
+                body = json.loads(e.read())
+            assert body["adapters_resident"] == 2
+
+
+class TestAdapterCheckpoint:
+    def test_roundtrip_and_corrupt_restore(self, model, lora_setup,
+                                           tmp_path):
+        cfg, _ = model
+        lora, ads = lora_setup
+        d = str(tmp_path)
+        save_adapter(d, "a0", ads["a0"])
+        back = restore_adapter(d, "a0")
+        check_adapter(back, cfg, lora)      # restored tree still fits
+        for x, y in zip(jax.tree_util.tree_leaves(ads["a0"]),
+                        jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        with pytest.raises(FileNotFoundError, match="a1"):
+            restore_adapter(d, "a1")
+        # corrupt one data byte → CheckpointCorruptError naming the path
+        import os
+        victim = max((os.path.join(r, f)
+                      for r, _, fs in os.walk(os.path.join(
+                          d, "adapter_a0")) for f in fs
+                      if "manifest" not in f and not f.endswith(".json")),
+                     key=os.path.getsize)
+        with open(victim, "r+b") as f:
+            f.seek(12)
+            b = f.read(1)
+            f.seek(12)
+            f.write(bytes([(b[0] + 1) % 256]))
+        with pytest.raises(CheckpointCorruptError, match="adapter_a0"):
+            restore_adapter(d, "a0")
+
+
+# ---------------------------------------------------------------------------
+# Adapter-affine fleet routing: pure host-side control flow, fake engines
+# (the test_fleet.py discipline — XLA buys nothing here).
+# ---------------------------------------------------------------------------
+
+
+class _FakeRegistry:
+    """Just enough registry surface for the router's quota walk."""
+
+    def __init__(self, names, quotas=None):
+        self._names = list(names)
+        self._quotas = dict(quotas or {})
+
+    def resident(self):
+        return tuple(self._names)
+
+    def quota(self, name):
+        return self._quotas.get(name)
+
+
+class _FakeEngine(ReadinessMixin):
+    def __init__(self, load=0, adapters=None, quotas=None):
+        self._queue = []
+        self._warmed = True
+        self._closed = False
+        self._load = load
+        self._resident = adapters           # None = no registry
+        self.adapters = (None if adapters is None
+                         else _FakeRegistry(adapters, quotas))
+        self.submits = []
+        self.loaded = []
+        self.loaded_quotas = {}
+
+    def load(self):
+        return self._load
+
+    def submit(self, *a, **kw):
+        self.submits.append((a, kw))
+        return "accepted"
+
+    def adapter_names(self):
+        return None if self._resident is None else tuple(self._resident)
+
+    def adapters_resident(self):
+        names = self.adapter_names()
+        return None if names is None else len(names)
+
+    def load_adapter(self, name, tree, quota=None):
+        if self._resident is None:
+            raise ValueError("engine has no AdapterRegistry")
+        self._resident.append(name)
+        self.adapters._names.append(name)
+        if quota is not None:
+            self.adapters._quotas[name] = quota
+        self.loaded.append(name)
+        self.loaded_quotas[name] = quota
+
+    def stats(self):
+        return {"requests_total": len(self.submits), "queue_depth": 0}
+
+    def shutdown(self, drain=True, timeout=None):
+        self._closed = True
+
+    def prom_collect(self):
+        return ({}, [])
+
+
+def _raise_overloaded(*a, **kw):
+    raise ServerOverloadedError("queue full")
+
+
+def _raise_valueerror(*a, **kw):
+    raise ValueError("malformed prompt")
+
+
+class TestAffineRouting:
+    def test_resident_replica_preferred_over_lower_load(self):
+        """Affinity first, load-count tiebreak unchanged WITHIN the
+        resident set; non-adapter requests keep pure least-load."""
+        warm = _FakeEngine(load=5, adapters=["a0"])
+        warm2 = _FakeEngine(load=9, adapters=["a0"])
+        cold = _FakeEngine(load=0, adapters=[])
+        router = FleetRouter(engines=[warm, warm2, cold])
+        assert router.submit("x", adapter="a0") == "accepted"
+        assert warm.submits and not warm2.submits and not cold.submits
+        router.submit("y")                      # least load, no adapter
+        assert cold.submits
+        assert router._metrics.adapter_dispatch_counts() == {
+            "affine": 1, "miss": 0}
+        assert router.adapters_resident() == 1
+
+    def test_miss_lazy_loads_via_source(self):
+        source_calls = []
+
+        def source(name):
+            source_calls.append(name)
+            return {"layers": []}
+
+        lo = _FakeEngine(load=0, adapters=[])
+        hi = _FakeEngine(load=7, adapters=[])
+        router = FleetRouter(engines=[lo, hi], adapter_source=source)
+        assert router.submit("x", adapter="a9") == "accepted"
+        assert lo.loaded == ["a9"] and source_calls == ["a9"]
+        assert not hi.submits
+        assert router._metrics.adapter_dispatch_counts()["miss"] == 1
+        # second request for a9: now resident → affine, no new load
+        router.submit("y", adapter="a9")
+        assert source_calls == ["a9"]
+        assert router._metrics.adapter_dispatch_counts()["affine"] == 1
+
+    def test_miss_without_source_raises_named_valueerror(self):
+        router = FleetRouter(engines=[_FakeEngine(load=0, adapters=[])])
+        with pytest.raises(ValueError, match="a7"):
+            router.submit("x", adapter="a7")
+        # a fleet of registry-less engines can't host adapters at all:
+        # the lazy load reaches the engine, whose own refusal surfaces
+        router2 = FleetRouter(engines=[_FakeEngine(load=0)],
+                              adapter_source=lambda name: {"layers": []})
+        with pytest.raises(ValueError, match="AdapterRegistry"):
+            router2.submit("x", adapter="a7")
+        assert router2.adapters_resident() is None
+
+    def test_overloaded_resident_replica_stays_retryable(self):
+        """A resident replica rejecting on LOAD plus a registry-less
+        replica must surface as retryable overload, not as the
+        hosting ValueError — backpressure on a hosting-capable replica
+        clears; 'cannot host' does not."""
+        busy = _FakeEngine(load=0, adapters=["a0"])
+        busy.submit = _raise_overloaded
+        hostless = _FakeEngine(load=1)          # no registry
+        router = FleetRouter(engines=[busy, hostless],
+                             adapter_source=lambda n: {"layers": []})
+        with pytest.raises(ServerOverloadedError):
+            router.submit("x", adapter="a0")
+
+    def test_lazy_load_bounded_to_one_per_dispatch(self):
+        """An overloaded burst must not replicate the adapter into
+        every table on the failover walk (rows are never auto-evicted):
+        at most ONE replica is seeded per dispatch, and the retry —
+        backpressure is retryable — seeds the next one on demand."""
+        full = _FakeEngine(load=0, adapters=[])
+        full.submit = _raise_overloaded
+        spare = _FakeEngine(load=1, adapters=[])
+        third = _FakeEngine(load=2, adapters=[])
+        router = FleetRouter(engines=[full, third, spare],
+                             adapter_source=lambda n: {"layers": []})
+        # full (least load) gets the one lazy load, rejects; the other
+        # miss candidates are SKIPPED, so the fleet answers retryable
+        # overload with spare/third untouched.
+        with pytest.raises(ServerOverloadedError):
+            router.submit("x", adapter="a5")
+        assert full.loaded == ["a5"]
+        assert spare.loaded == [] and third.loaded == []
+        # the retry prefers the (still overloaded) resident replica,
+        # then seeds exactly ONE more on demand — the least-loaded miss
+        assert router.submit("x", adapter="a5") == "accepted"
+        assert spare.loaded == ["a5"] and third.loaded == []
+
+    def test_evict_race_fails_over_to_other_resident_replica(self):
+        """A dispatch losing an evict race (resident at snapshot time,
+        gone by submit — the engine's retain raises ValueError) must
+        fail over to another resident replica, not error terminally."""
+        class _EvictedEngine(_FakeEngine):
+            def submit(self, *a, **kw):
+                raise ValueError(
+                    "adapter 'a0' is not resident — load() it first")
+
+        raced = _EvictedEngine(load=0, adapters=["a0"])
+        healthy = _FakeEngine(load=5, adapters=["a0"])
+        router = FleetRouter(engines=[raced, healthy])
+        assert router.submit("x", adapter="a0") == "accepted"
+        assert healthy.submits
+        # a genuinely malformed NON-adapter request still raises
+        router2 = FleetRouter(engines=[_FakeEngine(load=0)])
+        router2.replicas()[0].engine.submit = _raise_valueerror
+        with pytest.raises(ValueError, match="malformed"):
+            router2.submit("x")
+
+    def test_lazy_load_propagates_tenant_quota(self):
+        """A lazy load must not mint a quota-free copy of the adapter:
+        the quota rides over from a replica that already hosts it."""
+        capped = _FakeEngine(load=0, adapters=["a0"], quotas={"a0": 5})
+        capped.submit = _raise_overloaded
+        fresh = _FakeEngine(load=1, adapters=[])
+        router = FleetRouter(engines=[capped, fresh],
+                             adapter_source=lambda n: {"layers": []})
+        assert router.submit("x", adapter="a0") == "accepted"
+        assert fresh.loaded_quotas == {"a0": 5}
+
+    def test_lazy_load_race_with_concurrent_submit(self):
+        """A concurrent submit that loaded (and is streaming on) the
+        same adapter makes the registry refuse our redundant reload
+        with RuntimeError — the dispatch must proceed, not error."""
+        class _RacyEngine(_FakeEngine):
+            def load_adapter(self, name, tree, quota=None):
+                # the race: someone else loaded it between our residency
+                # check and the load
+                self._resident.append(name)
+                raise RuntimeError(
+                    f"adapter {name!r} is referenced by 1 live stream(s)")
+
+        racy = _RacyEngine(load=0, adapters=[])
+        router = FleetRouter(engines=[racy],
+                             adapter_source=lambda n: {"layers": []})
+        assert router.submit("x", adapter="a3") == "accepted"
+        assert racy.submits
+
+    def test_fleet_gauge_and_poller_line(self, monkeypatch):
+        """hvd_fleet_adapters_resident rides the fleet registry and the
+        FleetPoller serving line folds it in as 'adapters=K resident' —
+        from the SAME labeled parse as the rest of the line (no second
+        scrape)."""
+        router = FleetRouter(engines=[
+            _FakeEngine(adapters=["a0", "a1"]),
+            _FakeEngine(adapters=["a1"])])
+        text = router.prom_metrics()
+        assert "hvd_fleet_adapters_resident 2" in text
+        from horovod_tpu.obs import summary
+        from horovod_tpu.obs.registry import parse_exposition
+        fake = parse_exposition(
+            'hvd_fleet_replicas{state="ready"} 2\n'
+            'hvd_queue_depth{replica="r0"} 3\n'
+            'hvd_fleet_adapters_resident 2\n')
+        calls = []
+        monkeypatch.setattr(
+            summary, "scrape_exposition",
+            lambda *a, **k: calls.append(a) or fake)
+        poller = summary.FleetPoller("h", 9100, 1)
+        line = poller.line()
+        assert poller.last_mode == "serving"
+        assert "adapters=2 resident" in line
+        assert len(calls) == 1              # one scrape per poll
