@@ -55,6 +55,20 @@ asserts grow >= 1, shrink back to the floor, zero lost streams, and a
 ``stream_digest`` identical to the single-replica run of the same
 seeded traffic.
 
+``--chaos CLAUSE`` arms the serving-plane fault injector
+(``testing/faults.py``) for the run: ``replica_kill=r1@stream=3`` kills
+replica r1's engine loop at its 3rd admitted stream,
+``replica_hang=...`` wedges it instead, ``slow_step=MS`` slows every
+decode iteration. With ``--replicas N`` the FleetRouter's deterministic
+failover must then resume every stranded stream bit-identically — the
+ci.sh serving chaos drill compares the per-tenant ``stream_digests``
+against an unkilled single-replica reference and asserts
+``failover.resumed >= 1`` with zero lost streams. ``--temperature`` /
+``--top-k`` switch the traffic to seeded sampling (per-request seeds
+are a pure function of the tenant + arrival index, so digests stay
+run-to-run comparable) — failover bit-identity is pinned for greedy
+AND sampled streams.
+
 Exit status is nonzero if any *in-deadline* request was dropped at the
 configured operating point — the regression gate ci.sh's serve smokes
 rely on (the generate smoke additionally requires nonzero tokens/sec).
@@ -335,6 +349,16 @@ def run_gen_point(eng, qps: float, duration: float,
         sent_by_tenant[t] += 1
         try:
             kw = {} if t == "base" else {"adapter": t}
+            if args.temperature > 0:
+                # Seeded sampling: the seed is a pure function of the
+                # tenant and its arrival index, so the k-th request of
+                # tenant t samples the SAME stream in every run of the
+                # same knobs — sampled digests stay as comparable across
+                # runs (and across failover replays) as greedy ones.
+                from horovod_tpu.serve import SamplingParams
+                kw["sampling"] = SamplingParams(
+                    temperature=args.temperature, top_k=args.top_k,
+                    seed=9000 + 131 * tenants.index(t) + sent_by_tenant[t])
             handles.append((t, eng.submit(prompt, **kw)))
         except ServerOverloadedError:
             overload += 1
@@ -398,6 +422,10 @@ def run_gen_point(eng, qps: float, duration: float,
         "adapters": args.adapters,
         "adapter_mix": dict(zip(tenants, weights)),
         "adapter_only": args.adapter_only or None,
+        # Traffic shape + injected faults, so a digest-bearing row is
+        # self-describing about what produced it.
+        "temperature": args.temperature,
+        "chaos": args.chaos or None,
         "tenant_sent": sent_by_tenant,
         "tenant_completed": done_by_tenant,
         "stream_digests": {t: _stream_digest(s)
@@ -417,6 +445,8 @@ def run_gen_point(eng, qps: float, duration: float,
         row["replicas"] = snap["fleet"]["replicas"]
         row["scale_events"] = snap["fleet"]["scale_events"]
         row["dispatch"] = snap["fleet"]["dispatch_total"]
+        row["failover"] = snap["fleet"]["failover_total"]
+        row["stranded"] = snap["fleet"]["streams_stranded_total"]
         if "adapter_dispatch" in snap["fleet"]:
             row["adapter_dispatch"] = snap["fleet"]["adapter_dispatch"]
     return row, streams_by_tenant
@@ -560,6 +590,20 @@ def main():
                         "work per ready replica")
     p.add_argument("--scale-low", type=float, default=0.5,
                    help="[generate, --autoscale] shrink watermark")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="[generate] sampling temperature (0 = greedy); "
+                        ">0 switches every request to seeded sampling "
+                        "with a per-(tenant, arrival-index) seed, so "
+                        "stream digests stay run-to-run comparable")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="[generate, --temperature>0] top-k cutoff "
+                        "(0 = full vocab)")
+    p.add_argument("--chaos", default="",
+                   help="[generate] serving-plane HVD_FAULT_SPEC clause(s) "
+                        "armed for this run, e.g. "
+                        "'replica_kill=r1@stream=3' — the deterministic-"
+                        "failover drill knob (docs/fault_tolerance.md "
+                        "'Serving failures')")
     p.add_argument("--cache-mb", type=float, default=0,
                    help="[generate] fixed KV-cache byte budget; derives "
                         "slots (contiguous) or pool+slots (paged) — the "
@@ -582,6 +626,40 @@ def main():
         p.error("--adapters must be >= 0")
     if args.adapters and args.mode != "generate":
         p.error("--adapters applies to --mode generate only")
+    if args.temperature < 0:
+        p.error("--temperature must be >= 0 (0 = greedy)")
+    if args.top_k < 0:
+        p.error("--top-k must be >= 0 (0 = full vocab)")
+    if args.chaos:
+        if args.mode != "generate":
+            p.error("--chaos applies to --mode generate only (serving-"
+                    "plane clauses fire inside the generation engine "
+                    "loop)")
+        from horovod_tpu.testing import faults
+        try:
+            clauses = faults.parse_spec(args.chaos)
+        except faults.FaultSpecError as e:
+            p.error(str(e))
+        if not any(f.target == "serve" for f in clauses):
+            p.error(f"--chaos {args.chaos!r} has no serving-plane clause "
+                    f"(replica_kill= / replica_hang= / slow_step=) — "
+                    f"training-plane drills belong to tpurun, not the "
+                    f"bench")
+        if any(f.action in ("replica_kill", "replica_hang")
+               for f in clauses) \
+                and args.replicas <= 1 and not args.autoscale:
+            # A bare engine's serve_name stays "engine" — a clause
+            # targeting r0/r1 could never fire, and the run would read
+            # as a passed drill that never drilled anything.
+            p.error("--chaos replica_kill/replica_hang needs a fleet "
+                    "(--replicas >= 2 or --autoscale): replica names "
+                    "are stamped by the FleetRouter, and a kill drill "
+                    "without a surviving replica has nothing to fail "
+                    "over to")
+        # Armed via the one env knob every injection rides — the engine
+        # loops read it, so this must land BEFORE engines are built.
+        os.environ["HVD_FAULT_SPEC"] = args.chaos
+        faults.reset()
     if args.adapter_mix and not args.adapters:
         p.error("--adapter-mix needs --adapters N")
     if args.mode == "generate":
@@ -659,6 +737,12 @@ def _fleet_settle(eng, args, lost_streams: int, streams_by_tenant=None):
         "scale_events": snap["fleet"]["scale_events"],
         "dispatch": snap["fleet"]["dispatch_total"],
         "drained_lost_streams": lost_streams,
+        # The failover plane's whole-run verdict (ISSUE 15 chaos drill):
+        # every stranded stream must be resumed (bit-identically) or
+        # counted exhausted — never silently lost.
+        "failover": snap["fleet"]["failover_total"],
+        "stranded": snap["fleet"]["streams_stranded_total"],
+        "chaos": args.chaos or None,
     }
     if streams_by_tenant is not None:
         # Per-tenant digest map over the WHOLE run (all operating

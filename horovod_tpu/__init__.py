@@ -97,6 +97,7 @@ from .exceptions import (  # noqa: F401
     ServerOverloadedError,
     DeadlineExceededError,
     ServerClosedError,
+    FailoverExhaustedError,
     CheckpointCorruptError,
     CheckpointTimeoutError,
     NonFiniteGradError,

@@ -104,6 +104,23 @@ class ServerClosedError(HorovodError):
     """
 
 
+class FailoverExhaustedError(HorovodError):
+    """A generation stream stranded by replica death could not be
+    resumed anywhere: it failed on its retry budget's worth of replicas
+    (or the replay itself failed terminally on every attempt).
+
+    Delivered through the stream's handle by the
+    :class:`horovod_tpu.serve.FleetRouter` failover plane — the
+    serving-plane analog of exhausting ``tpurun --restarts``. Distinct
+    from :class:`ServerOverloadedError` on purpose: overload means "the
+    fleet is full, back off and retry"; this means "this STREAM died N
+    times and the router refuses to retry-storm it" — counted separately
+    (``hvd_failover_total{outcome="exhausted"}``) so a dashboard can
+    tell load shedding from failover churn. The client must re-submit
+    from scratch if it still wants the result.
+    """
+
+
 class CheckpointCorruptError(HorovodError):
     """A checkpoint's bytes do not match its integrity manifest.
 

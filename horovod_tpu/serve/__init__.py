@@ -92,6 +92,7 @@ from ..parallel.transformer import (  # noqa: F401
 )
 from ..exceptions import (  # noqa: F401
     DeadlineExceededError,
+    FailoverExhaustedError,
     ServerClosedError,
     ServerOverloadedError,
 )

@@ -235,8 +235,13 @@ class Engine(ReadinessMixin):
         req.future.request = req
         try:
             depth = self._queue.put(req)   # raises Closed
-        except ServerOverloadedError:
+        except ServerOverloadedError as e:
             self._metrics.on_overload()
+            # Backoff hint for the 503 (satellite of the failover
+            # plane): time until the full queue drains at the measured
+            # service rate — proportional backoff beats a fixed retry.
+            e.retry_after_ms = self._metrics.retry_after_ms(
+                len(self._queue))
             raise
         self._metrics.on_submit(depth)
         return req.future

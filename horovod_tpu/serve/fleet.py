@@ -35,7 +35,22 @@ stream before leaving. Scale-up is hitless: the new replica reads
 
 Multi-process replica liveness rides the EXISTING ``coord/`` heartbeat
 plane (:func:`heartbeat_liveness`) — the fleet never grows a second
-liveness protocol.
+liveness protocol. Thread replicas ride the engine's own in-process
+probe (:meth:`~.generate.GenerationEngine.loop_alive`), which reads
+dead on loop-thread death AND on a wedged loop (work pending, no
+completed iteration inside the stall window).
+
+The failover interplay (ISSUE 15): every :meth:`poll_once` starts with
+:meth:`~.router.FleetRouter.poll`, whose eviction of a liveness-dead
+replica now STRANDS-AND-RESUMES — the router re-dispatches the dead
+replica's tracked streams to surviving ready replicas and replays them
+bit-identically (the dead member costs capacity, never a client
+stream). Two control loops then cooperate without coordination: the
+router's own lazy sweep thread delivers the death verdict even on a
+static fleet with no autoscaler, while the autoscaler's below-min
+refill (the liveness promise above) restores the lost capacity on its
+next tick. Both paths are idempotent — a double poll evicts once,
+and an already-finished stream ignores its death verdict.
 """
 
 from __future__ import annotations

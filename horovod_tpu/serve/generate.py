@@ -50,6 +50,7 @@ queue's FIFO semantics trivially correct.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import queue as std_queue
 import threading
 import time
@@ -62,6 +63,8 @@ import numpy as np
 
 from ..exceptions import (DeadlineExceededError, ServerClosedError,
                           ServerOverloadedError)
+from ..obs import flightrec
+from ..testing import faults
 from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
                                   init_paged_kv_cache, paged_decode_step,
                                   paged_prefill)
@@ -275,6 +278,10 @@ class _GenRequest:
     tenant: str = "base"
     adapter: Optional[str] = None
     adapter_slot: int = -1
+    # Engine-local stream id: the flight recorder's serving events
+    # (admit/complete/crash) key on it, so a dead replica's post-mortem
+    # can name exactly which streams were in flight.
+    stream_id: int = -1
     # Prefix-reuse registry salt: a prompt's cached K/V is a function of
     # the weights that wrote it, so tenants must never hit each other's
     # prefixes (nor a reloaded adapter its predecessor's). Base traffic
@@ -384,10 +391,56 @@ class GenerationEngine(ReadinessMixin):
         self._closed = False
         self._warmed = False
         self._abort = False
+        # Serving-plane identity + liveness surface. ``serve_name`` is
+        # stamped by the fleet router at attach (fault clauses and
+        # flight-recorder events name replicas by it); the loop beat +
+        # admitted-stream counter feed loop_alive() and the fault hook.
+        self.serve_name = "engine"
+        self._beat = time.monotonic()
+        self._stall_mark: Optional[Tuple[float, float]] = None
+        self._streams_started = 0
+        self._loop_error_dumped = False
+        self._stream_seq = itertools.count()
         self._thread = threading.Thread(target=self._loop,
                                         name="hvd-generate-loop",
                                         daemon=True)
         self._thread.start()
+
+    def loop_alive(self, stall_s: float = 60.0) -> bool:
+        """The in-process liveness probe a :class:`~.router.
+        ReplicaHandle` polls for thread replicas: False once this
+        engine's loop thread died without a shutdown (abrupt death —
+        the ``replica_kill`` drill shape), or once the loop has been
+        OBSERVED with work pending (live slots, held or queued
+        requests) and no completed iteration for ``stall_s`` seconds
+        (a wedged loop — the ``replica_hang`` drill shape). The stall
+        clock starts at the first busy observation with no progress
+        since, NOT at the raw loop-beat age: an IDLE loop parks in the
+        untimed queue wait by design, so its beat is legitimately
+        stale — a request landing in that queue must not read as a
+        wedge before the loop has had ``stall_s`` to wake. ``stall_s``
+        must still cover the engine's worst single iteration — a lazy
+        first-bucket compile can legitimately hold the loop for tens
+        of seconds on CPU."""
+        if self._closed:
+            return True     # a drained/shut-down loop exit is not death
+        if not self._thread.is_alive():
+            return False
+        if not stall_s:
+            return True
+        busy = (any(r is not None for r in self._slots)
+                or self._held or len(self._queue))
+        now = time.monotonic()
+        if not busy:
+            self._stall_mark = None
+            return True
+        mark = self._stall_mark
+        if mark is not None and self._beat != mark[1]:
+            mark = None     # the loop iterated since the last mark
+        if mark is None:
+            self._stall_mark = (now, self._beat)
+            return True
+        return now - mark[0] <= stall_s
 
     # -- compile cache -----------------------------------------------------
 
@@ -599,7 +652,7 @@ class GenerationEngine(ReadinessMixin):
                              else now + deadline_ms / 1e3),
                 rng=np.random.default_rng(sampling.seed),
                 tenant=tenant, adapter=adapter, adapter_slot=a_slot,
-                prefix_salt=salt)
+                prefix_salt=salt, stream_id=next(self._stream_seq))
             handle.request = req
             try:
                 depth = self._queue.put(req)   # raises Closed / Overloaded
@@ -607,9 +660,14 @@ class GenerationEngine(ReadinessMixin):
                 self._tenant_release(tenant)
                 reason, detail = self._overload_reason(toks.size, max_new)
                 self._metrics.on_overload(reason)
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"request queue full ({self._cfg.max_queue}); "
-                    f"{reason}: {detail}") from None
+                    f"{reason}: {detail}")
+                # Backoff hint for the 503: how long until this queue
+                # has drained at the engine's measured service rate.
+                err.retry_after_ms = self._metrics.retry_after_ms(
+                    len(self._queue))
+                raise err from None
             except ServerClosedError:
                 self._tenant_release(tenant)
                 raise
@@ -618,6 +676,9 @@ class GenerationEngine(ReadinessMixin):
                 self._adapters.release(adapter)
             raise
         self._metrics.on_submit(depth)
+        flightrec.record("serve_admit", replica=self.serve_name,
+                         stream=req.stream_id, tenant=tenant,
+                         prompt_len=int(toks.size))
         return handle
 
     def _tenant_admit(self, tenant: str) -> None:
@@ -632,10 +693,12 @@ class GenerationEngine(ReadinessMixin):
             inflight = self._tenant_inflight.get(tenant, 0)
             if quota is not None and inflight >= quota:
                 self._metrics.on_overload("tenant_quota")
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"tenant {tenant!r} over quota: {inflight} streams "
                     f"in flight >= quota {quota} — finish streams or "
                     f"raise the tenant's quota")
+                err.retry_after_ms = self._metrics.retry_after_ms(inflight)
+                raise err
             self._tenant_inflight[tenant] = inflight + 1
 
     def _tenant_label(self, req: _GenRequest) -> Optional[str]:
@@ -799,8 +862,11 @@ class GenerationEngine(ReadinessMixin):
             self._abort = True
             self._fail_pending()
         self._thread.join(timeout)
-        if self._thread.is_alive():
-            self._fail_pending()
+        # Unconditional second sweep: a DEAD loop (kill drill, loop
+        # crash) joins instantly with its queue unserved, and a racing
+        # submit can slip past the _closed check into an already-swept
+        # queue — whatever is still pending here will never be served.
+        self._fail_pending()
 
     def _fail_pending(self) -> None:
         cancelled = 0
@@ -821,9 +887,37 @@ class GenerationEngine(ReadinessMixin):
 
     # -- the continuous-batching loop --------------------------------------
 
+    def _crash_dump(self, reason: str) -> None:
+        """Flight-recorder post-mortem for THIS replica: one event
+        naming every in-flight stream id, then the ring dump — what an
+        operator reads after a replica death to know which streams the
+        failover plane had to resume."""
+        inflight = [r.stream_id for r in self._slots if r is not None]
+        inflight += [r.stream_id for r in self._held]
+        flightrec.record("serve_crash", replica=self.serve_name,
+                         inflight=inflight, queued=len(self._queue))
+        flightrec.dump(reason=f"serving replica {self.serve_name}: "
+                              f"{reason}")
+
     def _loop(self):
         while True:
             try:
+                self._beat = time.monotonic()
+                act = faults.serve_hook(self.serve_name,
+                                        self._streams_started)
+                if act == "kill":
+                    # Abrupt loop death: the thread exits WITHOUT
+                    # failing its handles — a crashed process cannot
+                    # deliver failures. The stranded streams are the
+                    # fleet failover drill's whole point; the dump is
+                    # the post-mortem a real dead replica would leave.
+                    self._crash_dump("fault injection: replica_kill")
+                    return
+                if act == "hang":
+                    # Park forever with the thread ALIVE: only the
+                    # stale-beat half of loop_alive() can catch this.
+                    while True:
+                        time.sleep(3600)
                 if self._abort:
                     err = ServerClosedError(
                         "server shut down before completion")
@@ -868,6 +962,17 @@ class GenerationEngine(ReadinessMixin):
                         "with the engine idle — admission accounting bug"))
                     self._req_done(req)
             except Exception as e:  # noqa: BLE001 — deliver, don't die
+                # Every active stream is about to fail: leave the
+                # post-mortem FIRST (the handles' owners may be remote
+                # clients who only ever see a broken stream). Dumped
+                # once per engine: the loop keeps serving after an
+                # error, and a deterministic per-batch fault must not
+                # pay an fsync'd dump on every occurrence inside the
+                # hot loop (the ring keeps recording; a later DEATH —
+                # kill, abort — still dumps the fresher events).
+                if not self._loop_error_dumped:
+                    self._loop_error_dumped = True
+                    self._crash_dump(f"engine loop error: {e!r}")
                 self._fail_active(e)
 
     def _fail_active(self, exc: BaseException) -> None:
@@ -932,6 +1037,7 @@ class GenerationEngine(ReadinessMixin):
             if reservation is None:
                 return "starved"
         req.t_admit = now
+        self._streams_started += 1     # the serve_hook @stream counter
         try:
             length = int(req.tokens.size)
             bucket = bucket_for(length, self._buckets)
@@ -1054,6 +1160,9 @@ class GenerationEngine(ReadinessMixin):
         self._metrics.on_response((now - req.enqueued_at) * 1e3,
                                   (req.t_admit - req.enqueued_at) * 1e3)
         self._req_done(req)
+        flightrec.record("serve_complete", replica=self.serve_name,
+                         stream=req.stream_id, n_tokens=req.n_out,
+                         reason=reason)
         req.handle._finish({
             "tokens": list(req.handle._tokens),
             "finish_reason": reason,

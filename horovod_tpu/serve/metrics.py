@@ -254,6 +254,24 @@ class ServeMetrics:
             self.prefix_hit_blocks_total += hit_blocks
             self.prefix_lookup_blocks_total += prompt_blocks
 
+    def retry_after_ms(self, queue_depth: int) -> float:
+        """Backoff hint for an overload rejection: roughly how long
+        until the CURRENT queue has drained, from the engine's own
+        measured service rate (responses ÷ uptime — for the generation
+        engine that is tokens/sec divided by tokens-per-stream, the
+        same number). A well-behaved client sleeping this long lands
+        when its request can actually be admitted, instead of hammering
+        a full door at its own retry cadence. Clamped to [50 ms, 30 s];
+        1 s before the first response (no rate measured yet)."""
+        with self._lock:
+            done = self.responses_total
+            uptime = time.monotonic() - self._t0
+        if done > 0 and uptime > 0:
+            hint = (queue_depth + 1) / (done / uptime) * 1e3
+        else:
+            hint = 1000.0
+        return min(30000.0, max(50.0, hint))
+
     def forget_tenant(self, tenant: str) -> None:
         """The tenant's adapter was evicted: fold its COUNTERS into the
         one ``tenant="retired"`` aggregate and drop its recorders and
@@ -476,7 +494,21 @@ class FleetMetrics:
       a skewed split means a sick replica);
     * ``hvd_fleet_scale_events_total{direction=}`` — autoscaler
       decisions committed (``grow`` / ``shrink``), pre-seeded at 0 so
-      "no event yet" is a scrapeable fact, not a missing series.
+      "no event yet" is a scrapeable fact, not a missing series;
+    * ``hvd_streams_stranded_total`` — streams whose serving replica
+      died (or aborted) with the stream in flight;
+    * ``hvd_failover_total{outcome=}`` — failover verdicts: ``resumed``
+      (re-dispatched and the replayed prefix verified — the stream
+      continued bit-identically) vs ``exhausted`` (failed on its whole
+      retry budget, waited out the overload window, or diverged on
+      replay; terminated with the ``failover_exhausted`` reason).
+      Pre-seeded at 0, and deliberately NOT folded into the overload
+      counters: load shedding and failover churn are different operator
+      problems. NOTE: ``stranded_total`` can exceed
+      ``resumed + exhausted`` — a stranded stream that meets its OWN
+      verdict mid-failover (deadline expiry, fleet shutdown) is counted
+      in the deadline/cancelled counters instead, not as a failover
+      outcome.
     """
 
     def __init__(self):
@@ -493,6 +525,14 @@ class FleetMetrics:
             labels=("direction",))
         for direction in ("grow", "shrink"):
             self._c_scale.labels(direction=direction)
+        self._c_stranded = self.registry.counter(
+            "hvd_streams_stranded_total",
+            "Streams whose replica died with the stream in flight")
+        self._c_failover = self.registry.counter(
+            "hvd_failover_total",
+            "Stranded-stream failover outcomes", labels=("outcome",))
+        for outcome in ("resumed", "exhausted"):
+            self._c_failover.labels(outcome=outcome)
         # Adapter-plane series, LAZY: a fleet that never sees an adapter
         # exposes neither (the gauge registers on the first non-None
         # residency report, the counter on the first adapter dispatch).
@@ -577,6 +617,28 @@ class FleetMetrics:
             return {}
         return {o: int(self._c_adapter_dispatch.labels(outcome=o).value)
                 for o in ("affine", "miss")}
+
+    def on_stranded(self, n: int = 1) -> None:
+        """``n`` streams were stranded by a replica death/abort."""
+        self._c_stranded.inc(n)
+
+    def on_failover(self, outcome: str) -> None:
+        """One stranded stream's terminal failover verdict: ``resumed``
+        (re-dispatched, the client's stream continued bit-identically)
+        or ``exhausted`` (the retry budget died — the stream terminated
+        with the ``failover_exhausted`` reason, never looping)."""
+        if outcome not in ("resumed", "exhausted"):
+            raise ValueError(
+                f"failover outcome must be 'resumed' or 'exhausted', "
+                f"got {outcome!r}")
+        self._c_failover.labels(outcome=outcome).inc()
+
+    def failover_counts(self) -> Dict[str, int]:
+        return {o: int(self._c_failover.labels(outcome=o).value)
+                for o in ("resumed", "exhausted")}
+
+    def stranded_count(self) -> int:
+        return int(self._c_stranded.value)
 
     def on_scale(self, direction: str) -> None:
         if direction not in ("grow", "shrink"):

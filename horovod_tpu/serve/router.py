@@ -32,12 +32,35 @@ Design rules, each load-bearing:
   (the bit-identity drill in tests/test_fleet.py and the ci.sh
   autoscaler leg pin exactly this).
 * **Liveness is the existing ``coord/`` heartbeat plane, not a second
-  protocol.** Thread replicas are in-process: their loop thread is the
-  ground truth. Multi-process replicas form a coordinator world whose
-  heartbeat timeouts (PR 1) already detect silence; a
-  :class:`ReplicaHandle` wires ``liveness=`` to that plane
+  protocol.** Thread replicas are in-process: their loop thread — plus
+  the engine's own loop-beat staleness probe
+  (:meth:`~.generate.GenerationEngine.loop_alive`, which also catches a
+  loop that is ALIVE but wedged mid-stream) — is the ground truth.
+  Multi-process replicas form a coordinator world whose heartbeat
+  timeouts (PR 1) already detect silence; a :class:`ReplicaHandle`
+  wires ``liveness=`` to that plane
   (:func:`~.fleet.heartbeat_liveness`) and the router EVICTS on its
-  verdict — it never grows its own poller.
+  verdict.
+* **A dead replica strands no stream: deterministic failover.** The
+  router records every admitted generation stream's full submission
+  envelope (prompt tokens, sampling params + seed, max_new, eos,
+  adapter, the ABSOLUTE deadline resolved at submit) and the tokens
+  already relayed to the client. When a replica is declared dead —
+  liveness verdict, loop death, or a stream-level engine failure — its
+  in-flight streams are re-dispatched to surviving ready replicas and
+  REPLAYED from the envelope: seeded generation makes the replayed
+  tokens bit-identical, the already-emitted prefix is suppressed (and
+  VERIFIED token-by-token — a diverging replay fails loudly rather
+  than double- or mis-emitting), so the client's single chunked HTTP
+  response simply continues. Replay keeps the submit-time absolute
+  deadline (failover never resets a clock). A per-stream retry budget
+  with backoff bounds the churn: a stream that failed on its budget's
+  worth of replicas terminates with
+  :class:`~horovod_tpu.exceptions.FailoverExhaustedError` (counted as
+  ``hvd_failover_total{outcome="exhausted"}``, separate from overload)
+  instead of retry-storming the fleet. Single-shot (``Future``) fleets
+  keep the old fail-fast behavior — only generation streams carry
+  enough determinism to resume.
 
 The router duck-types the engine surface (``submit`` / ``generate`` /
 ``infer`` / ``stats`` / ``health`` / ``prom_collect`` / ``warmup`` /
@@ -50,12 +73,17 @@ a ``replica=`` label) with the fleet series into ONE valid exposition,
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..exceptions import ServerClosedError, ServerOverloadedError
+from ..exceptions import (DeadlineExceededError, FailoverExhaustedError,
+                          ServerClosedError, ServerOverloadedError,
+                          WorkerFailureError)
+from ..obs import flightrec
+from .generate import GenerationHandle
 from .metrics import FleetMetrics
 
 _log = logging.getLogger("horovod_tpu.serve.fleet")
@@ -76,15 +104,20 @@ class ReplicaHandle:
     the replica's backing process is gone — for multi-process replicas
     this is the coord heartbeat plane
     (:func:`~.fleet.heartbeat_liveness`); thread replicas default to
-    their engine loop thread's aliveness. The handle never invents its
-    own poller.
+    the engine's in-process probe
+    (:meth:`~.generate.GenerationEngine.loop_alive` where the engine
+    has one — it catches a loop that is alive but WEDGED mid-stream,
+    ``stall_timeout_s`` being the staleness verdict — else the loop
+    thread's plain aliveness). The handle never invents its own poller.
     """
 
     def __init__(self, name: str, engine: Any,
-                 liveness: Optional[Callable[[], bool]] = None):
+                 liveness: Optional[Callable[[], bool]] = None,
+                 stall_timeout_s: float = 60.0):
         self.name = name
         self.engine = engine
         self._liveness = liveness
+        self._stall_timeout = stall_timeout_s
         self._draining = False
         self._dead = False
         self._drain_thread: Optional[threading.Thread] = None
@@ -97,8 +130,17 @@ class ReplicaHandle:
                 return bool(self._liveness())
             except Exception:  # noqa: BLE001 — a broken probe is "gone"
                 return False
-        # Thread replicas: the engine loop thread is the ground truth —
-        # it only exits on drain-complete or abort, both terminal.
+        # Thread replicas: the engine's own loop-beat probe where it has
+        # one (thread death AND a beat stale past stall_timeout_s with
+        # work pending both read dead) …
+        la = getattr(self.engine, "loop_alive", None)
+        if callable(la):
+            try:
+                return bool(la(self._stall_timeout))
+            except Exception:  # noqa: BLE001 — a broken probe is "gone"
+                return False
+        # … else the loop thread is the ground truth — it only exits on
+        # drain-complete or abort, both terminal.
         thread = getattr(self.engine, "_thread", None)
         if thread is not None and not thread.is_alive() \
                 and not getattr(self.engine, "_closed", False):
@@ -123,6 +165,40 @@ class ReplicaHandle:
             return 1 << 30
 
 
+class _FleetStream:
+    """One tracked generation stream: the client-facing handle, the full
+    submission envelope for deterministic replay, and the replay
+    bookkeeping (tokens already relayed, the suppression cursor over
+    them, the retry budget used). The pump thread owns all mutation
+    after construction; the sweeper only reads ``inner`` (under the
+    router's stream lock) to deliver a death verdict."""
+
+    __slots__ = ("sid", "args", "kwargs", "deadline_at", "inner",
+                 "client", "expect", "expect_i", "retries",
+                 "replica", "unconfirmed")
+
+    def __init__(self, sid: int, args: tuple, kwargs: dict,
+                 deadline_at: Optional[float], inner: GenerationHandle):
+        self.sid = sid
+        self.args = args
+        self.kwargs = kwargs                 # WITHOUT a rewritten deadline
+        self.deadline_at = deadline_at       # absolute, resolved at submit
+        self.inner = inner                   # current replica-side handle
+        self.client = GenerationHandle()     # what the caller holds
+        # (Tokens already relayed to the client live in
+        # ``client._tokens`` — the pump is the only writer, so a second
+        # copy here would just be an invariant to keep in sync.)
+        self.expect: List[int] = []          # replay-suppression reference
+        self.expect_i = 0
+        self.retries = 0
+        self.replica: Optional[str] = None   # current host replica name
+        # Re-dispatches whose replayed prefix has not yet VERIFIED: the
+        # "resumed" outcome is only counted once the replay catches up
+        # to the client's emitted tokens — a diverging replay must count
+        # exhausted, never both.
+        self.unconfirmed = 0
+
+
 class FleetRouter:
     """Admission router + replica membership for N serving engines.
 
@@ -144,6 +220,30 @@ class FleetRouter:
         closure over ``parallel.checkpoint.restore_adapter`` — the
         manifest-CRC walk then guards every lazy load). Without it, a
         non-resident adapter is a ``ValueError`` naming the remedy.
+        Also the prewarm source on scale-up: :meth:`add_replica` seeds
+        a grown replica's registry from the fleet's resident set.
+      failover_retries: per-stream failover budget — how many SUCCESSFUL
+        re-dispatches a stranded generation stream gets (i.e. how many
+        replicas it may fail ON) before it terminates with
+        ``failover_exhausted`` (never a retry storm). Overload
+        rejections do not consume this budget — they wait.
+      failover_backoff_s: floor/fallback sleep between failover
+        re-dispatch attempts that hit overload; a ``retry_after_ms``
+        hint on the rejection overrides it (capped at 2 s per nap).
+      failover_overload_wait_s: wall-clock budget a stranded stream may
+        spend waiting out fleet overload before it terminates with
+        ``failover_exhausted`` (a stream with a deadline is additionally
+        bounded by that deadline — load shedding must not convert a
+        30 s-deadline stream into a terminal error 0.3 s after a
+        replica death).
+      stall_timeout_s: the in-process liveness probe's staleness
+        verdict — an engine loop with work pending but no completed
+        iteration for this long reads dead (must cover the engine's
+        worst legitimate single iteration, e.g. a lazy first compile).
+      poll_interval_s: period of the router's own membership sweep
+        thread (started lazily with the first tracked generation
+        stream, so fault detection does not depend on an autoscaler
+        being attached); 0 disables — callers drive :meth:`poll`.
     """
 
     def __init__(self, engines: Optional[List[Any]] = None, *,
@@ -151,17 +251,41 @@ class FleetRouter:
                  initial: int = 0,
                  liveness_factory: Optional[Callable] = None,
                  drain_timeout: float = 60.0,
-                 adapter_source: Optional[Callable[[str], Any]] = None):
+                 adapter_source: Optional[Callable[[str], Any]] = None,
+                 failover_retries: int = 3,
+                 failover_backoff_s: float = 0.05,
+                 failover_overload_wait_s: float = 30.0,
+                 stall_timeout_s: float = 60.0,
+                 poll_interval_s: float = 0.5):
+        if failover_retries < 1:
+            raise ValueError(
+                f"failover_retries must be >= 1 (a stranded stream "
+                f"needs at least one re-dispatch attempt), got "
+                f"{failover_retries}")
         self._factory = factory
         self._liveness_factory = liveness_factory
         self._drain_timeout = drain_timeout
         self._adapter_source = adapter_source
+        self._failover_retries = failover_retries
+        self._failover_backoff = failover_backoff_s
+        self._failover_overload_wait = failover_overload_wait_s
+        self._stall_timeout = stall_timeout_s
+        self._poll_interval = poll_interval_s
         self._lock = threading.Lock()
         self._metrics = FleetMetrics()
         self._replicas: List[ReplicaHandle] = []
         self._seq = 0
         self._closed = False
         self._t0 = time.monotonic()
+        # The failover plane's stream registry: replica name -> live
+        # tracked streams (generation fleets only; Future fleets are
+        # not tracked). The sweeper thread starts with the first
+        # tracked stream.
+        self._streams_lock = threading.Lock()
+        self._live_streams: Dict[str, Dict[int, _FleetStream]] = {}
+        self._stream_seq = itertools.count()
+        self._sweeper: Optional[threading.Thread] = None
+        self._sweep_stop = threading.Event()
         # Final counter totals of replicas that LEFT the membership:
         # the fleet aggregates in stats() add these baselines so
         # cumulative fields (requests_total, tokens_generated_total,
@@ -201,8 +325,16 @@ class FleetRouter:
                 name = self._next_name()
             liveness = (self._liveness_factory(name)
                         if self._liveness_factory else None)
-            handle = ReplicaHandle(name, engine, liveness=liveness)
+            handle = ReplicaHandle(name, engine, liveness=liveness,
+                                   stall_timeout_s=self._stall_timeout)
             self._replicas.append(handle)
+        try:
+            # Stamp the fleet name onto the engine: fault clauses
+            # (replica_kill=<name>@stream=) and the flight recorder's
+            # serving events key on it.
+            engine.serve_name = name
+        except Exception:  # noqa: BLE001 — duck-typed engines may refuse
+            pass
         return handle
 
     def replicas(self) -> List[ReplicaHandle]:
@@ -233,6 +365,7 @@ class FleetRouter:
         with self._lock:
             name = self._next_name()
         handle = self._attach(self._factory(name), name=name)
+        self._seed_adapters(handle)
 
         def _warm():
             try:
@@ -248,6 +381,50 @@ class FleetRouter:
             t.start()
         self._refresh_gauges()
         return handle
+
+    def _seed_adapters(self, handle: ReplicaHandle) -> None:
+        """Adapter prewarming on scale-up (ROADMAP item 5): seed a
+        grown replica's ``AdapterRegistry`` from the fleet's CURRENTLY
+        resident adapter set — quotas carried along (the PR-14 rule: a
+        seeded copy must not mint a quota-free tenant) — instead of
+        filling by affinity misses. Needs ``adapter_source=`` (the only
+        way the router can mint adapter trees); without it, or for
+        registry-less engines, the replica fills on demand as before.
+        A replica SHARING another replica's registry is already warm
+        and skipped."""
+        if self._adapter_source is None:
+            return
+        load = getattr(handle.engine, "load_adapter", None)
+        reg_new = getattr(handle.engine, "adapters", None)
+        if not callable(load) or reg_new is None:
+            return
+        wanted: Dict[str, Optional[int]] = {}
+        for h in self.replicas():
+            reg = getattr(h.engine, "adapters", None)
+            if reg is None:
+                continue
+            if reg is reg_new and h is not handle:
+                return      # shared registry: already resident
+            if reg is reg_new:
+                continue    # the new replica itself
+            try:
+                for n in (reg.resident() or ()):
+                    if n not in wanted:
+                        wanted[n] = reg.quota(n)
+            except Exception:  # noqa: BLE001 — a dying replica has no say
+                continue
+        try:
+            already = set(reg_new.resident() or ())
+        except Exception:  # noqa: BLE001
+            already = set()
+        for n, q in sorted(wanted.items()):
+            if n in already:
+                continue
+            try:
+                load(n, self._adapter_source(n), quota=q)
+            except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+                _log.warning("adapter prewarm of %r on %s failed: %r",
+                             n, handle.name, e)
 
     def remove_replica(self, name: Optional[str] = None) -> ReplicaHandle:
         """Shrink the fleet by one replica, drain-on-evict: the replica
@@ -305,7 +482,28 @@ class FleetRouter:
         _log.warning("replica %s is dead (liveness verdict) — evicting "
                      "without drain", handle.name)
         handle._dead = True
-        self._retire(handle)
+        if not self._retire(handle):
+            # A concurrent poller (the router's own sweeper racing an
+            # autoscaler tick) won the eviction and already delivered
+            # the death verdicts; a second pass could _fail a stream's
+            # REPLACEMENT handle on a healthy replica.
+            return
+        # Strand-and-resume: deliver the death verdict through each
+        # tracked stream's inner handle — the pump thread (possibly
+        # parked in next_event on a handle that will never speak again)
+        # wakes and runs the failover. Idempotent on streams that
+        # already finished (_fail no-ops once done). Failing INSIDE the
+        # streams lock pins each verdict to the handle the stream holds
+        # while still registered under this replica: the pump's own
+        # failover unregisters before it swaps ``inner``, so a verdict
+        # can never land on a replacement handle.
+        with self._streams_lock:
+            stranded = list(self._live_streams.get(handle.name,
+                                                   {}).values())
+            for s in stranded:
+                s.inner._fail(WorkerFailureError(
+                    f"serving replica {handle.name} declared dead with "
+                    f"stream {s.sid} in flight"))
 
         def _reap():
             try:
@@ -316,14 +514,14 @@ class FleetRouter:
         threading.Thread(target=_reap, name=f"hvd-fleet-reap-{handle.name}",
                          daemon=True).start()
 
-    def _retire(self, handle: ReplicaHandle) -> None:
+    def _retire(self, handle: ReplicaHandle) -> bool:
         """Remove ``handle`` from membership, folding its final counter
         totals into the retired baselines so the fleet aggregates stay
         monotone (best-effort for a dead replica whose stats raise).
         Exactly-once: the fold happens only on the call that wins the
         membership removal — a drain completing while a liveness
         verdict evicts the same replica must not double-count its
-        history."""
+        history. Returns True iff this call won the removal."""
         snap: Dict[str, Any] = {}
         try:
             snap = handle.engine.stats()
@@ -331,7 +529,7 @@ class FleetRouter:
             pass
         with self._lock:
             if handle not in self._replicas:
-                return
+                return False
             self._replicas.remove(handle)
             for key in self._COUNTER_KEYS:
                 v = snap.get(key)
@@ -351,6 +549,7 @@ class FleetRouter:
                             and not isinstance(v, bool):
                         base[key] = base.get(key, 0) + v
         self._metrics.forget_replica(handle.name)
+        return True
 
     def _note_peak(self) -> None:
         """Sample the fleet's CURRENT total active streams into the
@@ -462,14 +661,34 @@ class FleetRouter:
         to least-load + lazy hot-load via ``adapter_source``. Raises
         :class:`ServerOverloadedError` only when EVERY ready replica
         rejected (or none is ready yet — a warming fleet is a retryable
-        condition), :class:`ServerClosedError` once the router (or the
-        whole membership) is shut down, ``ValueError`` when an adapter
-        is resident nowhere and cannot be lazy-loaded. Returns whatever
-        the replica's ``submit`` returns (a
-        :class:`~.generate.GenerationHandle` for generation fleets, a
-        ``Future`` for single-shot fleets)."""
+        condition; the error carries a ``retry_after_ms`` backoff hint,
+        the minimum over the replicas' own drain estimates),
+        :class:`ServerClosedError` once the router (or the whole
+        membership) is shut down, ``ValueError`` when an adapter is
+        resident nowhere and cannot be lazy-loaded.
+
+        Generation fleets return a fleet-owned
+        :class:`~.generate.GenerationHandle` backed by the
+        deterministic-failover plane: the stream's envelope is recorded
+        and a replica death mid-stream re-dispatches it, replaying
+        bit-identically with the emitted prefix suppressed — the caller
+        never sees the migration. Single-shot fleets return the
+        replica's ``Future`` unchanged (no failover)."""
         if self._closed:
             raise ServerClosedError("fleet router is shut down")
+        out, handle = self._dispatch(args, kwargs)
+        if not isinstance(out, GenerationHandle):
+            return out      # Future fleets: nothing deterministic to replay
+        return self._track(out, handle, args, kwargs)
+
+    def _dispatch(self, args: tuple, kwargs: dict,
+                  avoid: Optional[str] = None):
+        """One admission attempt over the current ready set (the shared
+        core of :meth:`submit` and the failover replay). Returns
+        ``(replica submit result, ReplicaHandle)`` or raises the fleet
+        verdict. ``avoid`` demotes that replica to the END of the walk
+        (a failover replay tries every OTHER door first, but a fleet
+        whose only ready replica is the avoided one still gets it)."""
         adapter = kwargs.get("adapter")
         snapshot = self.replicas()
         ready = [h for h in snapshot if h.state() == "ready"]
@@ -477,29 +696,35 @@ class FleetRouter:
         if adapter is not None:
             resident = {h.name: adapter in self._resident_names(h)
                         for h in ready}
-            ready.sort(key=lambda h: (not resident[h.name], h.load()))
+            ready.sort(key=lambda h: (h.name == avoid,
+                                      not resident[h.name], h.load()))
         else:
-            ready.sort(key=lambda h: h.load())
+            ready.sort(key=lambda h: (h.name == avoid, h.load()))
         if not ready:
             warming = sum(1 for h in snapshot if h.state() == "warming")
             if warming:
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     f"no ready replicas yet ({warming} warming) — retry "
                     f"after backoff")
+                err.retry_after_ms = 1000.0   # a warm-up, not a queue
+                raise err
             if self._factory is not None:
                 # An open router with a factory is one autoscaler tick
                 # away from a below-min refill — a terminal "closed"
                 # here would tell well-behaved clients to stop retrying
                 # a fleet about to heal.
-                raise ServerOverloadedError(
+                err = ServerOverloadedError(
                     "no live replicas right now (the fleet can refill) "
                     "— retry after backoff")
+                err.retry_after_ms = 1000.0
+                raise err
             raise ServerClosedError(
                 "fleet has no live replicas (all drained or dead)")
         last: Optional[BaseException] = None
         hosting_error: Optional[ValueError] = None
         saw_backpressure = False
         lazy_loaded = False
+        hints: List[float] = []
         for h in ready:
             if adapter is not None and not resident.get(h.name):
                 if lazy_loaded:
@@ -525,6 +750,9 @@ class FleetRouter:
             except ServerOverloadedError as e:
                 last = e
                 saw_backpressure = True
+                ra = getattr(e, "retry_after_ms", None)
+                if isinstance(ra, (int, float)):
+                    hints.append(float(ra))
                 continue
             except ServerClosedError as e:
                 # Raced a drain decision between the snapshot and the
@@ -549,7 +777,7 @@ class FleetRouter:
                 self._metrics.on_adapter_dispatch(
                     "affine" if resident.get(h.name) else "miss")
             self._note_peak()
-            return out
+            return out, h
         if adapter is not None and hosting_error is not None \
                 and not saw_backpressure:
             # EVERY ready replica failed to even HOST the adapter — a
@@ -558,9 +786,307 @@ class FleetRouter:
             # load, the condition IS retryable — fall through to the
             # overload below.)
             raise hosting_error
-        raise ServerOverloadedError(
+        err = ServerOverloadedError(
             f"all {len(ready)} ready replicas rejected the request "
             f"(last: {last}) — grow the fleet or shed load")
+        # The fleet-level backoff hint: the SOONEST any replica expects
+        # to drain its queue (the client only needs one door to open).
+        err.retry_after_ms = min(hints) if hints else 1000.0
+        raise err
+
+    # -- deterministic stream failover --------------------------------------
+
+    def _track(self, inner: GenerationHandle, handle: ReplicaHandle,
+               args: tuple, kwargs: dict) -> GenerationHandle:
+        """Wrap a freshly-dispatched generation stream in the failover
+        plane: record its envelope (with the deadline resolved to an
+        ABSOLUTE instant — the clock a replay must NOT reset), register
+        it under its host replica, and start the relay pump. Returns
+        the client-facing handle."""
+        now = time.monotonic()
+        deadline_ms = kwargs.get("deadline_ms")
+        if deadline_ms is None:
+            # The engine would apply its own default relative to ITS
+            # submit time; resolve it here so a replay keeps the
+            # original clock instead of restarting the default.
+            cfg = getattr(handle.engine, "_cfg", None)
+            deadline_ms = getattr(cfg, "default_deadline_ms", None)
+        stream = _FleetStream(
+            sid=next(self._stream_seq), args=args, kwargs=dict(kwargs),
+            deadline_at=(None if deadline_ms is None
+                         else now + deadline_ms / 1e3),
+            inner=inner)
+        self._register(stream, handle.name)
+        self._confirm_membership(stream, handle)
+        flightrec.record("serve_dispatch", stream=stream.sid,
+                         replica=handle.name)
+        self._ensure_sweeper()
+        # One relay thread per in-flight stream: bounded by the fleet's
+        # admission capacity (every stream lives in some replica's
+        # bounded queue/slots — the no-second-buffer rule), never by
+        # request rate.
+        threading.Thread(target=self._pump, args=(stream,),
+                         name=f"hvd-fleet-stream-{stream.sid}",
+                         daemon=True).start()
+        return stream.client
+
+    def _confirm_membership(self, stream: _FleetStream,
+                            handle: ReplicaHandle) -> None:
+        """Close the dispatch→register race with an eviction: a replica
+        declared dead between the submit that admitted this stream and
+        its registration was retired BEFORE ``_evict_dead`` snapshotted
+        the streams to strand, so nobody else will ever deliver its
+        death verdict (membership removal is exactly-once, and the
+        reaper may have drained the engine's queue before the submit
+        landed). ``_retire`` removes membership FIRST, so either the
+        eviction sees our registration or we see the eviction here —
+        there is no interleaving that misses both. Idempotent against
+        every competing verdict (``_fail`` no-ops on a done handle; a
+        drained replica's finished stream already has its events
+        queued)."""
+        with self._lock:
+            present = handle in self._replicas
+        if not present:
+            stream.inner._fail(WorkerFailureError(
+                f"serving replica {handle.name} left the membership "
+                f"while stream {stream.sid} was being dispatched to "
+                f"it"))
+
+    def _register(self, stream: _FleetStream, name: str) -> None:
+        with self._streams_lock:
+            stream.replica = name
+            self._live_streams.setdefault(name, {})[stream.sid] = stream
+
+    def _unregister(self, stream: _FleetStream) -> None:
+        with self._streams_lock:
+            if stream.replica is not None:
+                m = self._live_streams.get(stream.replica)
+                if m is not None:
+                    m.pop(stream.sid, None)
+                    if not m:
+                        self._live_streams.pop(stream.replica, None)
+            stream.replica = None
+
+    def _ensure_sweeper(self) -> None:
+        """Start the router's own membership sweep (lazily, with the
+        first tracked stream): liveness verdicts must fire even when no
+        autoscaler polls this router — a static 2-replica fleet still
+        promises failover."""
+        if self._sweeper is not None or self._poll_interval <= 0:
+            return
+        with self._lock:
+            if self._sweeper is not None or self._closed:
+                return
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="hvd-fleet-sweep",
+                daemon=True)
+        self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self._poll_interval):
+            if self._closed:
+                return
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 — a bad sweep must not stop
+                _log.exception("fleet membership sweep failed")
+
+    def _pump(self, stream: _FleetStream) -> None:
+        """Relay one stream's events from its current replica handle to
+        the client handle, surviving replica deaths: replica-level
+        failures trigger :meth:`_failover` (which swaps ``stream.inner``
+        and the relay continues), request-level verdicts (deadline,
+        malformed input) pass through. During a replay the suppression
+        cursor swallows — and VERIFIES — the already-emitted prefix, so
+        the client never sees a duplicate or diverging token."""
+        client = stream.client
+        while True:
+            kind, val = stream.inner.next_event()
+            if kind == "token":
+                if stream.expect_i < len(stream.expect):
+                    want = stream.expect[stream.expect_i]
+                    stream.expect_i += 1
+                    if val != want:
+                        # Bit-identity is the contract failover stands
+                        # on; a diverging replay must fail loudly, never
+                        # mis-continue a stream the client half-has.
+                        self._diverged(stream, client, FailoverExhaustedError(
+                            f"stream {stream.sid}: replayed token "
+                            f"{stream.expect_i - 1} diverged "
+                            f"({val} != {want}) — deterministic replay "
+                            f"broken, refusing to continue the stream"))
+                        return
+                    if stream.expect_i == len(stream.expect):
+                        self._confirm_resumes(stream)
+                    continue    # suppressed: the client already has it
+                self._confirm_resumes(stream)   # 0-token-prefix resumes
+                client._emit(val)
+            elif kind == "done":
+                if stream.expect_i < len(stream.expect):
+                    # The replay finished BEFORE reproducing the prefix
+                    # the client already holds — divergence by omission,
+                    # as terminal as a wrong token.
+                    self._diverged(stream, client, FailoverExhaustedError(
+                        f"stream {stream.sid}: replay finished after "
+                        f"{stream.expect_i} of {len(stream.expect)} "
+                        f"already-emitted tokens — deterministic replay "
+                        f"broken, refusing to continue the stream"))
+                    return
+                self._confirm_resumes(stream)
+                self._unregister(stream)
+                info = dict(val)
+                info["failovers"] = stream.retries
+                client._finish(info)
+                return
+            else:   # ("error", exc)
+                if isinstance(val, DeadlineExceededError):
+                    # The REQUEST's own verdict, not the replica's: the
+                    # deadline is absolute — a replay would expire at
+                    # the same instant, so there is nothing to resume.
+                    # (A malformed request, by contrast, is rejected at
+                    # SUBMIT time, synchronously, and never reaches the
+                    # pump — an error event from a replica that already
+                    # ADMITTED the stream is the replica's fault
+                    # whatever the exception type, and fails over.)
+                    self._unregister(stream)
+                    client._fail(val)
+                    return
+                if self._closed:
+                    # Fleet shutdown cancelled it — not a strand.
+                    self._unregister(stream)
+                    client._fail(val)
+                    return
+                if not self._failover(stream, val):
+                    return      # terminal: the client was failed
+
+    def _confirm_resumes(self, stream: _FleetStream) -> None:
+        """The replayed prefix has fully VERIFIED: count the pending
+        re-dispatches as ``resumed`` outcomes. Deferred from the
+        re-dispatch itself so a diverging replay counts ``exhausted``
+        alone — the outcome labels partition verdicts, never overlap."""
+        while stream.unconfirmed:
+            stream.unconfirmed -= 1
+            self._metrics.on_failover("resumed")
+
+    def _diverged(self, stream: _FleetStream, client: GenerationHandle,
+                  err: FailoverExhaustedError) -> None:
+        stream.unconfirmed = 0      # these re-dispatches did NOT resume
+        self._unregister(stream)
+        self._metrics.on_failover("exhausted")
+        client._fail(err)
+
+    def _failover(self, stream: _FleetStream, cause: BaseException) -> bool:
+        """Re-dispatch a stranded stream onto a surviving replica,
+        replaying its envelope with the emitted prefix suppressed.
+        Returns True when the stream resumed (the pump continues on the
+        new ``stream.inner``), False when it terminated. Bounded by the
+        per-stream retry budget — only a SUCCESSFUL re-dispatch consumes
+        it (the budget counts replicas the stream may fail ON) — and,
+        for overload rejections, by the ``failover_overload_wait_s``
+        wall clock with hint-driven naps. Either bound exhausting (or a
+        terminal hosting error on every replica) fails the client with
+        :class:`FailoverExhaustedError` — counted as
+        ``hvd_failover_total{outcome="exhausted"}``, never a loop."""
+        prev = stream.replica
+        self._unregister(stream)
+        if stream.client.done():
+            return False
+        self._metrics.on_stranded()
+        flightrec.record("serve_failover", stream=stream.sid,
+                         replica=prev, cause=repr(cause))
+        last: BaseException = cause
+        overload_t0: Optional[float] = None
+        while stream.retries < self._failover_retries:
+            if self._closed:
+                stream.client._fail(ServerClosedError(
+                    f"fleet shut down while failing over stream "
+                    f"{stream.sid}"))
+                return False
+            if stream.deadline_at is not None \
+                    and time.monotonic() >= stream.deadline_at:
+                # The ORIGINAL absolute deadline — replay never resets
+                # the clock, so expiry during failover is the same
+                # verdict the stream would have met in a queue.
+                stream.client._fail(DeadlineExceededError(
+                    f"deadline expired while failing over stream "
+                    f"{stream.sid} (stranded on {prev}: {cause!r})"))
+                return False
+            kwargs = dict(stream.kwargs)
+            if stream.deadline_at is not None:
+                kwargs["deadline_ms"] = max(
+                    1.0, (stream.deadline_at - time.monotonic()) * 1e3)
+            try:
+                # Avoid the replica the stream just failed on: a SICK
+                # but alive replica (loop errors every stream, thread
+                # survives) empties its own queue, so a plain least-load
+                # pick would hand the stream straight back and burn the
+                # whole budget on one broken member while healthy
+                # replicas sit idle.
+                out, handle = self._dispatch(stream.args, kwargs,
+                                             avoid=prev)
+            except ServerOverloadedError as e:
+                # The FLEET's condition, not this stream's fault:
+                # waiting out overload spends the overload wall clock,
+                # never the re-dispatch budget (a 3-retry stream must
+                # not turn terminal 3 naps after a replica death just
+                # because the survivors were momentarily full). The nap
+                # honors the rejection's own ``retry_after_ms`` hint,
+                # floored at the configured backoff, capped at 2 s and
+                # at the stream's remaining deadline.
+                last = e
+                now = time.monotonic()
+                if overload_t0 is None:
+                    overload_t0 = now
+                elif now - overload_t0 >= self._failover_overload_wait:
+                    break       # waited the whole overload budget
+                ra = getattr(e, "retry_after_ms", None)
+                nap = (float(ra) / 1e3
+                       if isinstance(ra, (int, float)) and ra > 0
+                       else self._failover_backoff)
+                nap = min(2.0, max(nap, self._failover_backoff))
+                if stream.deadline_at is not None:
+                    nap = min(nap, max(0.0, stream.deadline_at - now))
+                time.sleep(nap)
+                continue
+            except ServerClosedError as e:
+                stream.client._fail(e)
+                return False
+            except ValueError as e:
+                # Terminal hosting/config error on every replica (the
+                # _dispatch contract) — more attempts cannot help.
+                last = e
+                break
+            if not isinstance(out, GenerationHandle):
+                last = TypeError(
+                    f"failover re-dispatch returned {type(out).__name__},"
+                    f" not a generation stream")
+                break
+            stream.retries += 1
+            stream.inner = out
+            stream.expect = list(stream.client._tokens)
+            stream.expect_i = 0
+            # "resumed" is NOT counted yet: the pump confirms it once
+            # the replayed prefix verifies against the client's tokens.
+            stream.unconfirmed += 1
+            self._register(stream, handle.name)
+            self._confirm_membership(stream, handle)
+            flightrec.record("serve_failover_resumed", stream=stream.sid,
+                             replica=handle.name, attempt=stream.retries,
+                             suppressed=len(stream.expect))
+            _log.warning(
+                "stream %d: failed over %s -> %s (attempt %d, replaying "
+                "%d emitted tokens suppressed) after %r", stream.sid,
+                prev, handle.name, stream.retries, len(stream.expect),
+                cause)
+            return True
+        stream.unconfirmed = 0      # nothing re-dispatched stuck
+        self._metrics.on_failover("exhausted")
+        stream.client._fail(FailoverExhaustedError(
+            f"stream {stream.sid} could not be resumed "
+            f"(re-dispatched {stream.retries} time(s); stranded on "
+            f"{prev} by {cause!r}; last: {last!r}) — re-submit from "
+            f"scratch"))
+        return False
 
     def generate(self, tokens, timeout: Optional[float] = None, **kw):
         """Synchronous generation through the fleet (submit + result)."""
@@ -592,6 +1118,12 @@ class FleetRouter:
         if self._closed:
             return
         self._closed = True
+        # Stop the membership sweeper FIRST and wait for it: a daemon
+        # thread left sleeping into interpreter teardown can abort the
+        # process from the C++ runtime's static destructors.
+        self._sweep_stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout)
         handles = self.replicas()
         threads = []
         for h in handles:
@@ -763,6 +1295,8 @@ class FleetRouter:
             **{f"n_{s}": n for s, n in self.counts().items()},
             "dispatch_total": self._metrics.dispatch_counts(),
             "scale_events": self._metrics.scale_counts(),
+            "failover_total": self._metrics.failover_counts(),
+            "streams_stranded_total": self._metrics.stranded_count(),
             **({"adapter_dispatch": adapter_dispatch}
                if adapter_dispatch else {}),
         }

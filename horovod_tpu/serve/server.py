@@ -64,13 +64,30 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet: the engine's metrics are the log
         pass
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _overloaded(self, e) -> None:
+        """The 503 for :class:`ServerOverloadedError`, carrying the
+        engine's ``retry_after_ms`` hint (queue depth ÷ measured
+        service rate) in the body AND as a conventional ``Retry-After``
+        header — so well-behaved clients back off proportionally to the
+        actual drain time instead of hammering a full door."""
+        body = {"error": str(e), "retryable": True}
+        headers = None
+        ra = getattr(e, "retry_after_ms", None)
+        if isinstance(ra, (int, float)) and not isinstance(ra, bool):
+            body["retry_after_ms"] = float(ra)
+            headers = {"Retry-After": str(max(1, int(-(-ra // 1000))))}
+        self._reply(503, body, headers)
 
     def _primary(self):
         """The engine whose health/stats this server reports: the
@@ -207,7 +224,7 @@ class _Handler(BaseHTTPRequestHandler):
                 kind, val = handle.next_event()
             self._chunk(b"")    # 0-length chunk terminates the stream
         except ServerOverloadedError as e:
-            self._reply(503, {"error": str(e), "retryable": True})
+            self._overloaded(e)
         except DeadlineExceededError as e:
             self._reply(504, {"error": str(e)})
         except ServerClosedError as e:
@@ -249,7 +266,7 @@ class _Handler(BaseHTTPRequestHandler):
             out = self.engine.infer(x, deadline_ms=deadline_ms)
             self._reply(200, {"outputs": np.asarray(out).tolist()})
         except ServerOverloadedError as e:
-            self._reply(503, {"error": str(e), "retryable": True})
+            self._overloaded(e)
         except DeadlineExceededError as e:
             self._reply(504, {"error": str(e)})
         except ServerClosedError as e:

@@ -17,11 +17,17 @@ This module turns an env spec into precise failures:
     HVD_FAULT_SPEC=resize:shrink=2@step=3      # live-shrink the world by 2
     HVD_FAULT_SPEC=resize:grow=4@step=3        # live-grow the world by 4
     HVD_FAULT_SPEC=resize:world=2@step=3       # live-resize to exactly 2
+    HVD_FAULT_SPEC=replica_kill=r1@stream=3    # serving: kill replica r1's
+                                               #   engine loop at its 3rd stream
+    HVD_FAULT_SPEC=replica_hang=r0@stream=2    # serving: hang the loop instead
+    HVD_FAULT_SPEC=slow_step=50                # serving: 50 ms per decode step
 
 Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>``,
 ``coord:mute@step=<s>`` / ``coord:delay_ms=<n>``,
-``ckpt:<truncate|flip|drop_marker>@step=<s>``, or
-``resize:<shrink|grow|world>=<k>@step=<s>``. Step-scoped actions
+``ckpt:<truncate|flip|drop_marker>@step=<s>``,
+``resize:<shrink|grow|world>=<k>@step=<s>``, or a serving-plane clause
+``replica_kill=<name>@stream=<k>`` / ``replica_hang=<name>@stream=<k>``
+/ ``slow_step=<ms>``. Step-scoped actions
 REQUIRE ``@step`` (a clause that could never fire is rejected loudly);
 ``delay_ms`` is unconditional — it has no step context and rejects
 ``@step``. Every clause takes an optional ``@epoch=<e>`` suffix
@@ -34,6 +40,22 @@ step, strictly AFTER the two-phase commit completes (marker on disk) —
 modeling post-commit bit rot / torn replication, the failure class the
 integrity manifests + verified fallback restore exist for. They fire on
 every rank (each env-world rank owns a private checkpoint copy).
+
+Serving-plane clauses (``replica_kill`` / ``replica_hang`` /
+``slow_step``) fire inside a :class:`horovod_tpu.serve.generate.
+GenerationEngine` loop — the chaos analog of a serving replica dying,
+wedging, or running slow under load. Replicas are in-process loop
+threads, so "kill" is an abrupt loop-thread death (the thread exits
+WITHOUT failing its handles — a crashed process cannot deliver
+failures; the stranded streams are exactly what the fleet router's
+deterministic failover must resume) and "hang" parks the loop forever
+with heartbeats-of-a-sort still flowing (the thread stays alive — only
+the in-process liveness probe's stale-beat verdict can catch it).
+``@stream=<k>`` scopes the trigger to the replica's k-th ADMITTED
+stream, so the kill always lands mid-stream, deterministically.
+``slow_step=<ms>`` sleeps in every engine loop iteration on EVERY
+replica (no ``@stream`` — it models a slow chip, not an event).
+:func:`serve_hook` is called once per engine loop iteration.
 
 ``resize`` clauses inject a live elastic resize at the matching step
 boundary — the chaos-drill analog of a spot-preemption notice
@@ -88,25 +110,93 @@ _ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms",
             "shrink", "grow", "world")
 _CKPT_ACTIONS = ("truncate", "flip", "drop_marker")
 _RESIZE_ACTIONS = ("shrink", "grow", "world")
+_SERVE_ACTIONS = ("replica_kill", "replica_hang", "slow_step")
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    target: str              # "rank" or "coord"
+    target: str              # "rank", "coord", "ckpt", "resize" or "serve"
     rank: Optional[int]      # rank the fault applies to (None for coord)
-    action: str              # one of _ACTIONS
+    action: str              # one of _ACTIONS / _SERVE_ACTIONS
     step: Optional[int]      # fire at this step (None = unconditional)
     epoch: int = 0           # fire only on this HVD_RESTART_EPOCH
-    value: int = 0           # delay_ms payload
+    value: int = 0           # delay_ms / slow_step payload
+    name: Optional[str] = None    # serving replica name (serve target)
+    stream: Optional[int] = None  # fire at this admitted-stream count
 
 
 class FaultSpecError(ValueError):
     """Malformed ``HVD_FAULT_SPEC`` — loud, like every other env knob."""
 
 
+def _parse_serve_clause(clause: str) -> Fault:
+    """One serving-plane clause: ``replica_kill=<name>@stream=<k>`` /
+    ``replica_hang=<name>@stream=<k>`` / ``slow_step=<ms>`` — same
+    loud-validation standard as the training-plane grammar (a drill
+    that could never fire is a spec bug, not a no-op)."""
+    parts = clause.split("@")
+    action, _, val = parts[0].partition("=")
+    stream: Optional[int] = None
+    epoch = 0
+    for cond in parts[1:]:
+        key, _, cval = cond.partition("=")
+        try:
+            if key == "stream":
+                stream = int(cval)
+            elif key == "epoch":
+                epoch = int(cval)
+            else:
+                raise FaultSpecError(
+                    f"{ENV_VAR}: unknown condition {cond!r} in clause "
+                    f"{clause!r} (expected stream=<k> or epoch=<n>)")
+        except ValueError:
+            raise FaultSpecError(
+                f"{ENV_VAR}: bad condition {cond!r} in clause "
+                f"{clause!r}") from None
+    if action == "slow_step":
+        try:
+            ms = int(val)
+        except ValueError:
+            raise FaultSpecError(
+                f"{ENV_VAR}: bad delay in clause {clause!r} (expected "
+                f"slow_step=<ms>)") from None
+        if ms < 1:
+            raise FaultSpecError(
+                f"{ENV_VAR}: slow_step={ms} in clause {clause!r} — the "
+                f"per-step delay must be >= 1 ms")
+        if stream is not None:
+            # The delay applies to EVERY loop iteration on EVERY replica
+            # (a slow chip, not an event); accepting @stream would
+            # silently drop the condition.
+            raise FaultSpecError(
+                f"{ENV_VAR}: slow_step does not support @stream (clause "
+                f"{clause!r}) — the delay applies to every engine loop "
+                f"iteration")
+        return Fault(target="serve", rank=None, action="slow_step",
+                     step=None, epoch=epoch, value=ms)
+    if not val:
+        raise FaultSpecError(
+            f"{ENV_VAR}: clause {clause!r} — {action} needs a replica "
+            f"name ({action}=<name>@stream=<k>)")
+    if stream is None or stream < 1:
+        # serve_hook fires on an admitted-stream count, so a clause
+        # without @stream>=1 could never fire deterministically.
+        raise FaultSpecError(
+            f"{ENV_VAR}: {action} requires @stream=<k> with k >= 1 "
+            f"(clause {clause!r}); the kill must land on a definite "
+            f"stream to be a drill")
+    return Fault(target="serve", rank=None, action=action, step=None,
+                 epoch=epoch, name=val, stream=stream)
+
+
 def parse_spec(text: str) -> List[Fault]:
     faults: List[Fault] = []
     for clause in filter(None, (c.strip() for c in text.split(","))):
+        if any(clause.startswith(a + "=") for a in _SERVE_ACTIONS):
+            # Serving-plane clauses carry no '<target>:' prefix — the
+            # action name IS the discriminator.
+            faults.append(_parse_serve_clause(clause))
+            continue
         target, _, rest = clause.partition(":")
         rank: Optional[int] = None
         if target.startswith("rank="):
@@ -287,8 +377,8 @@ def step_hook(step: int) -> None:
         return
     epoch = _restart_epoch()
     for i, f in enumerate(faults):
-        if f.target in ("ckpt", "resize"):
-            continue  # fire from ckpt_hook / resize_hook instead
+        if f.target in ("ckpt", "resize", "serve"):
+            continue  # fire from ckpt_hook / resize_hook / serve_hook
         if f.action == "delay_ms" or f.step != step or f.epoch != epoch:
             continue
         if f.target == "rank" and f.rank != _my_rank():
@@ -431,6 +521,46 @@ def resize_hook(step: int, world_size: int) -> Optional[int]:
                          world=world_size, target=target)
         return target
     return None
+
+
+def serve_hook(replica: str, streams_admitted: int) -> Optional[str]:
+    """Fire any serving-plane clause scoped to engine ``replica`` —
+    called once per :class:`~horovod_tpu.serve.generate.
+    GenerationEngine` loop iteration (near-zero-cost no-op unless the
+    spec has a serve clause). Returns ``"kill"`` (the loop must die
+    abruptly, stranding its handles — the deterministic-failover drill
+    shape), ``"hang"`` (the loop must park forever with its thread
+    alive — only a stale-beat liveness probe catches it), or None.
+    ``slow_step`` clauses sleep here directly, every call.
+
+    ``streams_admitted`` is the replica's cumulative count of streams
+    admitted into decode slots; a ``@stream=k`` clause fires once that
+    count reaches k — i.e. with stream k mid-flight, deterministically.
+    """
+    faults = _active()
+    if not faults:
+        return None
+    epoch = _restart_epoch()
+    out: Optional[str] = None
+    for i, f in enumerate(faults):
+        if f.target != "serve" or f.epoch != epoch:
+            continue
+        if f.action == "slow_step":
+            time.sleep(f.value / 1000.0)
+            continue
+        if f.name != replica or streams_admitted < (f.stream or 0):
+            continue
+        key = (i, epoch)
+        if key in _fired:
+            continue
+        _fired.add(key)
+        from ..obs import flightrec
+        flightrec.record("fault", action=f.action, replica=replica,
+                         stream=f.stream)
+        print(f"[faults] serving replica {replica}: {f.action} at "
+              f"admitted stream {f.stream} (epoch {epoch})", flush=True)
+        out = "kill" if f.action == "replica_kill" else "hang"
+    return out
 
 
 def coord_delay() -> None:

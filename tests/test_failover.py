@@ -1,0 +1,704 @@
+"""Deterministic stream failover (ISSUE 15): the FleetRouter's
+strand-and-resume plane, the serving-plane fault-injection grammar, the
+retry budget, and the overload backoff hints.
+
+Router failover LOGIC runs against fake generation engines whose token
+streams are a pure function of the prompt — exactly the determinism
+contract real seeded engines provide, at zero compile cost — so the
+bulk of this file is milliseconds of host-side control flow. ONE
+real-engine drill (the tier-1 budget rule) pins the end-to-end claim:
+a ``replica_kill`` fault mid-stream strands zero streams and every
+client-visible stream is bit-identical to an uninterrupted
+single-engine run, with the dead replica leaving a flight-recorder
+post-mortem naming its in-flight streams. The heavier open-loop chaos
+drill lives in ci.sh (serve_bench --chaos), not here.
+"""
+
+import glob
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import serve
+from horovod_tpu.exceptions import (DeadlineExceededError,
+                                    FailoverExhaustedError,
+                                    ServerOverloadedError)
+from horovod_tpu.obs.registry import parse_exposition, render
+from horovod_tpu.serve.engine import ReadinessMixin
+from horovod_tpu.serve.generate import GenerationHandle
+from horovod_tpu.serve.metrics import FleetMetrics, ServeMetrics
+from horovod_tpu.serve.router import FleetRouter
+from horovod_tpu.testing import faults
+
+
+# ---------------------------------------------------------------------------
+# Fake generation engines: tokens are a pure function of the prompt, so
+# a replay on ANY replica reproduces the stream — the property seeded
+# real engines provide, without a single compile.
+# ---------------------------------------------------------------------------
+
+class _Cfg:
+    default_deadline_ms = None
+
+
+class _FakeGenEngine(ReadinessMixin):
+    """Emits ``max_new_tokens`` tokens ``prompt[-1]+1, +2, ...``.
+
+    ``strand_after=k`` emits k tokens and then goes silent WITHOUT
+    finishing or failing the handle — the crashed-replica shape (a dead
+    process delivers nothing; only the router's liveness verdict can
+    wake the stream). ``fail_after=k`` emits k tokens then fails the
+    handle with ``fail_with`` — the engine-loop-error shape.
+    ``diverge`` offsets every token by 100: a replica that breaks the
+    determinism contract."""
+
+    def __init__(self, warmed=True, load=0, reject=None,
+                 strand_after=None, fail_after=None, fail_with=None,
+                 fail_always=False, finish_after=None, diverge=False):
+        self._queue = []
+        self._warmed = warmed
+        self._closed = False
+        self._load = load
+        self._cfg = _Cfg()
+        self.reject = reject
+        self.reject_count = 0
+        self.strand_after = strand_after
+        self.fail_after = fail_after
+        self.fail_with = fail_with or RuntimeError("engine loop error")
+        self.fail_always = fail_always   # keep failing on every submit
+        self.finish_after = finish_after  # truncated-but-"done" replay
+        self.diverge = diverge
+        self.alive_flag = True
+        self.submits = []
+
+    def load(self):
+        return self._load
+
+    def loop_alive(self, stall_s=0.0):
+        return self.alive_flag
+
+    def submit(self, tokens, *, max_new_tokens=4, sampling=None,
+               eos_id=None, deadline_ms=None, adapter=None):
+        if self.reject is not None:
+            self.reject_count += 1
+            raise self.reject
+        self.submits.append({"tokens": list(tokens),
+                             "deadline_ms": deadline_ms,
+                             "adapter": adapter})
+        off = 100 if self.diverge else 0
+        toks = [int(tokens[-1]) + 1 + i + off
+                for i in range(max_new_tokens)]
+        h = GenerationHandle()
+        if self.strand_after is not None:
+            for t in toks[:self.strand_after]:
+                h._emit(t)
+            self.strand_after = None    # a later replay runs clean
+        elif self.fail_after is not None:
+            for t in toks[:self.fail_after]:
+                h._emit(t)
+            h._fail(self.fail_with)
+            if not self.fail_always:
+                self.fail_after = None
+        elif self.finish_after is not None:
+            short = toks[:self.finish_after]
+            for t in short:
+                h._emit(t)
+            h._finish({"tokens": short, "finish_reason": "length",
+                       "n_tokens": len(short)})
+        else:
+            for t in toks:
+                h._emit(t)
+            h._finish({"tokens": toks, "finish_reason": "length",
+                       "n_tokens": len(toks)})
+        return h
+
+    def warmup(self):
+        self._warmed = True
+
+    def shutdown(self, drain=True, timeout=None):
+        self._closed = True
+
+    def stats(self):
+        return {}
+
+    def prom_collect(self):
+        return {}, []
+
+
+def _router(*engines, **kw):
+    # poll_interval_s=0: tests deliver liveness verdicts via poll() —
+    # deterministic, no background sweep racing the assertions.
+    kw.setdefault("poll_interval_s", 0)
+    kw.setdefault("failover_backoff_s", 0.001)
+    return FleetRouter(engines=list(engines), **kw)
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Arm HVD_FAULT_SPEC for one test; always disarm the fired-set."""
+    def arm(spec):
+        monkeypatch.setenv("HVD_FAULT_SPEC", spec)
+        faults.reset()
+    yield arm
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Failover on fakes: the router contracts.
+# ---------------------------------------------------------------------------
+
+class TestFailover:
+    def test_dead_replica_strands_nothing_stream_resumes_bit_identical(self):
+        e0 = _FakeGenEngine(load=0, strand_after=2)
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        h = router.submit([7], max_new_tokens=4)
+        # e0 (least loaded) took the stream, emitted 2 tokens, froze.
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert h._tokens == [8, 9] and not h.done()
+        e0.alive_flag = False
+        router.poll()           # the liveness verdict: strand-and-resume
+        r = h.result(timeout=5)
+        # The client's single stream: the replayed prefix was suppressed
+        # (never re-emitted) and the tail continued bit-identically.
+        assert r["tokens"] == [8, 9, 10, 11]
+        assert h._tokens == [8, 9, 10, 11]
+        assert r["failovers"] == 1
+        assert e1.submits and e1.submits[0]["tokens"] == [7]
+        assert router._metrics.failover_counts() == {"resumed": 1,
+                                                     "exhausted": 0}
+        assert router._metrics.stranded_count() == 1
+        assert router.counts()["ready"] == 1    # e0 evicted, not drained
+        router.shutdown()
+
+    def test_engine_loop_error_fails_over_without_liveness_verdict(self):
+        # A stream-level engine failure (the loop delivered an error
+        # through the handle) re-dispatches immediately — no poll needed.
+        e0 = _FakeGenEngine(load=0, fail_after=1)
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        r = router.submit([3], max_new_tokens=3).result(timeout=5)
+        assert r["tokens"] == [4, 5, 6]
+        assert r["failovers"] == 1
+        assert router._metrics.failover_counts()["resumed"] == 1
+        router.shutdown()
+
+    def test_retry_budget_exhausts_loudly_never_loops(self):
+        # The budget counts replicas the stream may FAIL ON: a sick
+        # survivor burning every re-dispatch exhausts after exactly
+        # failover_retries of them.
+        e0 = _FakeGenEngine(load=0, strand_after=1)
+        e1 = _FakeGenEngine(load=5, fail_after=0, fail_always=True)
+        router = _router(e0, e1, failover_retries=2)
+        h = router.submit([5], max_new_tokens=4)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        with pytest.raises(FailoverExhaustedError, match="re-submit"):
+            h.result(timeout=5)
+        # Exactly the budget's worth of re-dispatches — no storm — and
+        # the client kept the tokens it had, none double-emitted.
+        assert len(e1.submits) == 2
+        assert h._tokens == [6]
+        # None of the re-dispatches verified its replayed prefix (the
+        # sick replica failed before reproducing it), so the outcome is
+        # ONE exhausted, zero resumed — the labels partition verdicts.
+        assert router._metrics.failover_counts() == {"resumed": 0,
+                                                     "exhausted": 1}
+        # Each failed host is one strand event: e0's death + 2 sick
+        # re-dispatches.
+        assert router._metrics.stranded_count() == 3
+        router.shutdown()
+
+    def test_overload_waits_on_the_hint_without_burning_the_budget(self):
+        # Fleet overload during failover is the FLEET's condition: the
+        # stream naps on the rejection's retry_after_ms hint, bounded
+        # by the failover_overload_wait_s wall clock — the re-dispatch
+        # budget is never consumed, and the naps follow the hint (far
+        # fewer attempts than the backoff floor would produce).
+        reject = ServerOverloadedError("queue full")
+        reject.retry_after_ms = 10.0
+        e0 = _FakeGenEngine(load=0, strand_after=1)
+        e1 = _FakeGenEngine(load=5, reject=reject)
+        router = _router(e0, e1, failover_retries=2,
+                         failover_overload_wait_s=0.08)
+        h = router.submit([5], max_new_tokens=4)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        with pytest.raises(FailoverExhaustedError, match="re-submit"):
+            h.result(timeout=5)
+        # ~0.08 s of 10 ms naps: several attempts, nowhere near the
+        # ~80 a 1 ms backoff floor would have made — and no re-dispatch
+        # ever succeeded, so the budget shows zero consumed.
+        assert 2 <= e1.reject_count <= 30
+        assert h._tokens == [6]
+        assert router._metrics.failover_counts() == {"resumed": 0,
+                                                     "exhausted": 1}
+        assert router._metrics.stranded_count() == 1
+        router.shutdown()
+
+    def test_diverging_replay_fails_loudly_never_mis_continues(self):
+        e0 = _FakeGenEngine(load=0, strand_after=2)
+        e1 = _FakeGenEngine(load=5, diverge=True)
+        router = _router(e0, e1)
+        h = router.submit([7], max_new_tokens=4)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        with pytest.raises(FailoverExhaustedError, match="diverged"):
+            h.result(timeout=5)
+        # The suppression cursor VERIFIES the replay: the client saw no
+        # diverging token and no duplicate of its emitted prefix — and
+        # the outcome labels PARTITION verdicts: a diverging re-dispatch
+        # counts exhausted alone, never also resumed.
+        assert h._tokens == [8, 9]
+        assert router._metrics.failover_counts() == {"resumed": 0,
+                                                     "exhausted": 1}
+        router.shutdown()
+
+    def test_replay_finishing_short_of_the_prefix_is_divergence(self):
+        # A replay that ends BEFORE reproducing what the client already
+        # holds is divergence by omission — terminal, never a silent
+        # truncation of the client's stream.
+        e0 = _FakeGenEngine(load=0, strand_after=2)
+        e1 = _FakeGenEngine(load=5, finish_after=1)
+        router = _router(e0, e1)
+        h = router.submit([7], max_new_tokens=4)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        with pytest.raises(FailoverExhaustedError, match="finished"):
+            h.result(timeout=5)
+        assert h._tokens == [8, 9]
+        assert router._metrics.failover_counts() == {"resumed": 0,
+                                                     "exhausted": 1}
+        router.shutdown()
+
+    def test_failover_avoids_the_replica_the_stream_just_failed_on(self):
+        # A SICK-but-alive replica (fails every stream, loop thread
+        # survives, queue empties so its load reads lowest) must not
+        # eat the whole retry budget while a healthy replica sits idle.
+        sick = _FakeGenEngine(load=0, fail_after=1, fail_always=True)
+        healthy = _FakeGenEngine(load=9)
+        router = _router(sick, healthy)
+        r = router.submit([3], max_new_tokens=3).result(timeout=5)
+        assert r["tokens"] == [4, 5, 6]
+        assert r["failovers"] == 1          # one hop: sick -> healthy
+        assert len(healthy.submits) == 1
+        assert router._metrics.failover_counts() == {"resumed": 1,
+                                                     "exhausted": 0}
+        router.shutdown()
+
+    def test_submit_time_value_error_passes_through_untouched(self):
+        # A malformed request is rejected AT SUBMIT, synchronously —
+        # the REQUEST's failure, raised to the caller before any stream
+        # exists; the router must not burn failover attempts on it.
+        e0 = _FakeGenEngine(load=0, reject=ValueError("bad prompt"))
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        with pytest.raises(ValueError, match="bad prompt"):
+            router.submit([3])
+        assert not e1.submits
+        assert router._metrics.stranded_count() == 0
+        router.shutdown()
+
+    def test_mid_stream_value_error_is_a_replica_fault_and_fails_over(self):
+        # An error event from a replica that already ADMITTED the
+        # stream is the replica's fault whatever the exception type —
+        # an engine loop throwing ValueError on an admitted stream must
+        # not be misread as a request verdict (submit-time validation
+        # already happened).
+        e0 = _FakeGenEngine(load=0, fail_after=1,
+                            fail_with=ValueError("loop bug"))
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        r = router.submit([3], max_new_tokens=3).result(timeout=5)
+        assert r["tokens"] == [4, 5, 6]
+        assert r["failovers"] == 1
+        assert router._metrics.failover_counts() == {"resumed": 1,
+                                                     "exhausted": 0}
+        router.shutdown()
+
+    def test_eviction_racing_the_dispatch_register_window_strands_nothing(
+            self):
+        # A replica evicted BETWEEN the submit that admitted a stream
+        # and the router registering it: the eviction's strand sweep
+        # snapshotted streams before registration, so nobody else will
+        # ever deliver that death verdict — the router must self-check
+        # membership after registering and deliver it itself (without
+        # the check, the client's handle waits forever on a replica
+        # that no longer exists).
+        router_box = []
+
+        class _EvictDuringSubmit(_FakeGenEngine):
+            def submit(self, *a, **kw):
+                h = super().submit(*a, **kw)
+                # Die and get swept before the router can register the
+                # stream this submit just admitted.
+                self.alive_flag = False
+                router_box[0].poll()
+                return h
+
+        e0 = _EvictDuringSubmit(load=0, strand_after=1)
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        router_box.append(router)
+        r = router.submit([3], max_new_tokens=3).result(timeout=5)
+        assert r["tokens"] == [4, 5, 6]
+        assert r["failovers"] == 1
+        assert router._metrics.failover_counts()["resumed"] == 1
+        assert router.counts()["ready"] == 1
+        router.shutdown()
+
+    def test_single_shot_future_fleets_stay_untracked(self):
+        class _Single(ReadinessMixin):
+            def __init__(self):
+                self._queue = []
+                self._warmed = True
+                self._closed = False
+
+            def load(self):
+                return 0
+
+            def submit(self, *a, **kw):
+                return "a-future"
+
+            def shutdown(self, drain=True, timeout=None):
+                pass
+
+        router = _router(_Single())
+        assert router.submit("x") == "a-future"
+        assert router._live_streams == {}
+        router.shutdown()
+
+    def test_failover_retries_validated(self):
+        with pytest.raises(ValueError, match="failover_retries"):
+            _router(_FakeGenEngine(), failover_retries=0)
+
+
+class TestDeadlineThroughFailover:
+    def test_replay_keeps_the_original_absolute_deadline(self):
+        # The re-dispatched submit carries the REMAINING time of the
+        # submit-time deadline — failover never resets the clock.
+        e0 = _FakeGenEngine(load=0, strand_after=1)
+        e1 = _FakeGenEngine(load=5)
+        router = _router(e0, e1)
+        h = router.submit([5], max_new_tokens=3, deadline_ms=60000.0)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        r = h.result(timeout=5)
+        assert r["tokens"] == [6, 7, 8]
+        replayed = e1.submits[0]["deadline_ms"]
+        assert replayed is not None and 0 < replayed < 60000.0
+        router.shutdown()
+
+    def test_deadline_expiry_during_failover_is_deadline_not_overload(self):
+        # Every surviving replica rejects; the stream's ORIGINAL
+        # deadline passes while failover backs off — the verdict is
+        # DeadlineExceededError at the submit-time deadline, exactly as
+        # if the stream had expired in a queue.
+        e0 = _FakeGenEngine(load=0, strand_after=1)
+        e1 = _FakeGenEngine(load=5,
+                            reject=ServerOverloadedError("queue full"))
+        router = _router(e0, e1, failover_retries=1000,
+                         failover_backoff_s=0.01)
+        h = router.submit([5], deadline_ms=150.0)
+        deadline = time.monotonic() + 5
+        while len(h._tokens) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        e0.alive_flag = False
+        router.poll()
+        with pytest.raises(DeadlineExceededError, match="deadline"):
+            h.result(timeout=10)
+        assert router._metrics.failover_counts()["resumed"] == 0
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The serving-plane fault grammar + hook.
+# ---------------------------------------------------------------------------
+
+class TestServeFaultSpec:
+    def test_grammar_accepts_the_documented_forms(self):
+        fs = faults.parse_spec(
+            "replica_kill=r1@stream=3,replica_hang=r0@stream=2@epoch=1,"
+            "slow_step=50,rank=1:kill@step=3")
+        kill, hang, slow, rank = fs
+        assert (kill.target, kill.action, kill.name, kill.stream) == \
+            ("serve", "replica_kill", "r1", 3)
+        assert (hang.action, hang.name, hang.stream, hang.epoch) == \
+            ("replica_hang", "r0", 2, 1)
+        assert (slow.action, slow.value) == ("slow_step", 50)
+        assert rank.target == "rank"    # mixes with the training grammar
+
+    @pytest.mark.parametrize("bad", [
+        "replica_kill=r1",                  # no @stream: could never fire
+        "replica_kill=r1@stream=0",         # stream counts are 1-based
+        "replica_kill=@stream=3",           # no replica name
+        "replica_hang=r0@bogus=1",          # unknown condition
+        "replica_kill=r1@stream=x",         # non-integer stream
+        "slow_step=0",                      # a 0ms delay is a spec bug
+        "slow_step=abc",
+        "slow_step=50@stream=2",            # slow_step is unconditional
+    ])
+    def test_grammar_rejects_loudly(self, bad):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_serve_hook_fires_once_on_the_named_replica(self, fault_spec):
+        fault_spec("replica_kill=r1@stream=2")
+        assert faults.serve_hook("r1", 1) is None   # not yet at stream 2
+        assert faults.serve_hook("r0", 9) is None   # wrong replica
+        assert faults.serve_hook("r1", 2) == "kill"
+        assert faults.serve_hook("r1", 3) is None   # fired exactly once
+
+    def test_serve_hook_hang_and_slow_step(self, fault_spec):
+        fault_spec("replica_hang=r0@stream=1")
+        assert faults.serve_hook("r0", 1) == "hang"
+        fault_spec("slow_step=30")
+        t0 = time.monotonic()
+        assert faults.serve_hook("anything", 0) is None
+        assert time.monotonic() - t0 >= 0.025   # slept ~30ms, every call
+
+
+# ---------------------------------------------------------------------------
+# Metrics + backoff hints.
+# ---------------------------------------------------------------------------
+
+class TestFailoverMetrics:
+    def test_series_pre_seeded_and_validated(self):
+        m = FleetMetrics()
+        parsed = dict(((n, tuple(sorted(labels.items()))), v)
+                      for n, labels, v in m.registry.collect()[1])
+        assert parsed[("hvd_failover_total",
+                       (("outcome", "resumed"),))] == 0.0
+        assert parsed[("hvd_failover_total",
+                       (("outcome", "exhausted"),))] == 0.0
+        assert parsed[("hvd_streams_stranded_total", ())] == 0.0
+        m.on_stranded(2)
+        m.on_failover("resumed")
+        assert m.stranded_count() == 2
+        assert m.failover_counts() == {"resumed": 1, "exhausted": 0}
+        with pytest.raises(ValueError, match="outcome"):
+            m.on_failover("lost")
+        body = render(*m.registry.collect())
+        assert ("hvd_failover_total",
+                (("outcome", "resumed"),)) in parse_exposition(body)
+
+    def test_retry_after_ms_tracks_the_measured_service_rate(self):
+        m = ServeMetrics()
+        # No response yet: the 1 s default, clamped.
+        assert m.retry_after_ms(10) == 1000.0
+        # 100 responses over ~10 s -> 10/s; 19 queued + self ~= 2 s.
+        m.responses_total = 100
+        m._t0 = time.monotonic() - 10.0
+        assert 1800.0 <= m.retry_after_ms(19) <= 2200.0
+        assert m.retry_after_ms(0) >= 50.0          # clamp floor
+        assert m.retry_after_ms(10 ** 9) == 30000.0  # clamp ceiling
+
+    def test_fleet_overload_carries_the_soonest_drain_hint(self):
+        e_slow = ServerOverloadedError("full")
+        e_slow.retry_after_ms = 700.0
+        e_fast = ServerOverloadedError("full")
+        e_fast.retry_after_ms = 300.0
+        router = _router(_FakeGenEngine(load=0, reject=e_slow),
+                         _FakeGenEngine(load=1, reject=e_fast))
+        with pytest.raises(ServerOverloadedError) as ei:
+            router.submit([1])
+        assert ei.value.retry_after_ms == 300.0
+        router.shutdown()
+
+    def test_http_503_carries_retry_after_hint(self):
+        class _OverloadedEngine(ReadinessMixin):
+            _warmed = True
+
+            def __init__(self):
+                self._queue = []
+
+            def infer(self, x, deadline_ms=None):
+                err = ServerOverloadedError("queue full")
+                err.retry_after_ms = 2500.0
+                raise err
+
+        with serve.HttpServer(engine=_OverloadedEngine()) as srv:
+            req = urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/predict",
+                data=json.dumps({"inputs": [1.0]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            err = ei.value
+            body = json.loads(err.read())
+            assert err.code == 503
+            assert body["retryable"] is True
+            assert body["retry_after_ms"] == 2500.0
+            assert err.headers["Retry-After"] == "3"   # ceil(2.5 s)
+
+
+# ---------------------------------------------------------------------------
+# Adapter prewarming on scale-up (ROADMAP item 5 REMAINING).
+# ---------------------------------------------------------------------------
+
+class _FakeRegistry:
+    def __init__(self):
+        self.rows = {}
+        self.quotas = {}
+
+    def resident(self):
+        return tuple(self.rows)
+
+    def quota(self, name):
+        return self.quotas.get(name)
+
+
+class _FakeAdapterEngine(_FakeGenEngine):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.adapters = _FakeRegistry()
+
+    def load_adapter(self, name, tree, quota=None):
+        self.adapters.rows[name] = tree
+        self.adapters.quotas[name] = quota
+
+    def adapter_names(self):
+        return tuple(self.adapters.rows)
+
+
+class TestAdapterPrewarm:
+    def test_scale_up_seeds_resident_set_with_quotas(self):
+        e0 = _FakeAdapterEngine()
+        e0.load_adapter("a0", "tree:a0", quota=3)
+        e0.load_adapter("a1", "tree:a1")        # quota-free tenant
+        router = _router(e0, factory=lambda name: _FakeAdapterEngine(),
+                         adapter_source=lambda n: f"tree:{n}")
+        grown = router.add_replica(warm=False)
+        # The grown replica starts RESIDENT (not filling by affinity
+        # misses), and the PR-14 rule holds: quotas carried along, so a
+        # seeded copy never mints a quota-free tenant.
+        assert grown.engine.adapters.rows == {"a0": "tree:a0",
+                                              "a1": "tree:a1"}
+        assert grown.engine.adapters.quotas == {"a0": 3, "a1": None}
+        router.shutdown()
+
+    def test_no_adapter_source_means_no_seeding(self):
+        e0 = _FakeAdapterEngine()
+        e0.load_adapter("a0", "tree:a0")
+        router = _router(e0, factory=lambda name: _FakeAdapterEngine())
+        grown = router.add_replica(warm=False)
+        assert grown.engine.adapters.rows == {}
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ONE real-engine kill drill: the end-to-end bit-identity claim.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu.parallel.transformer import (TransformerConfig,
+                                                  init_params)
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                            d_ff=32, dtype=jnp.float32,
+                            unembed_dtype=jnp.float32, attn_backend="xla")
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _real_engine(model):
+    cfg, params = model
+    eng = serve.GenerationEngine(params, cfg, serve.GenerationConfig(
+        max_slots=2, max_len=16, default_max_new_tokens=6))
+    # Budget shortcut (the test_fleet.py pattern): compiles happen
+    # lazily on the one prompt bucket these prompts hit.
+    eng._warmed = True
+    return eng
+
+
+class TestLoopLiveness:
+    def test_idle_loop_is_never_stale_a_wedged_busy_loop_is(self, model):
+        # An idle engine parks in the untimed queue wait BY DESIGN: its
+        # raw beat age must never read as a wedge — not even at the
+        # instant new work lands (the stall clock starts at the first
+        # busy observation, giving the loop stall_s to wake). A loop
+        # observed busy with no beat progress past stall_s IS a wedge.
+        eng = _real_engine(model)
+        try:
+            time.sleep(0.05)
+            assert eng.loop_alive(0.01)         # idle: stale beat is fine
+            assert eng.loop_alive(0.01)         # ...and does not flap
+            eng._held.append(object())          # simulate stuck work
+            assert eng.loop_alive(0.04)         # first busy observation
+            time.sleep(0.08)
+            assert not eng.loop_alive(0.04)     # no progress: wedged
+            eng._held.clear()
+            assert eng.loop_alive(0.04)         # idle again: recovered
+        finally:
+            eng.shutdown(drain=False)
+
+
+class TestRealKillDrill:
+    def test_replica_kill_mid_stream_resumes_bit_identical(
+            self, model, fault_spec, monkeypatch, tmp_path):
+        monkeypatch.setenv("HVD_FLIGHTREC_DIR", str(tmp_path))
+        prompts = [[int(t) for t in p] for p in
+                   np.random.RandomState(7).randint(1, 32, size=(6, 4))]
+        # Greedy AND seeded-sampling streams in the same drill.
+        samplings = [None if i % 2 == 0 else
+                     serve.SamplingParams(temperature=0.8, seed=40 + i)
+                     for i in range(len(prompts))]
+        ref = _real_engine(model)
+        try:
+            ref_streams = sorted(
+                tuple(ref.generate(p, sampling=s, timeout=60)["tokens"])
+                for p, s in zip(prompts, samplings))
+        finally:
+            ref.shutdown()
+        fault_spec("replica_kill=r1@stream=2")
+        router = FleetRouter(engines=[_real_engine(model),
+                                      _real_engine(model)],
+                             poll_interval_s=0.05)
+        try:
+            handles = [router.submit(p, sampling=s)
+                       for p, s in zip(prompts, samplings)]
+            results = [h.result(timeout=60) for h in handles]
+            # Zero stranded streams, every token stream bit-identical to
+            # the uninterrupted single-engine run.
+            assert sorted(tuple(r["tokens"]) for r in results) \
+                == ref_streams
+            assert router._metrics.failover_counts()["resumed"] >= 1
+            assert router._metrics.failover_counts()["exhausted"] == 0
+            assert router._metrics.stranded_count() >= 1
+            assert sum(r["failovers"] for r in results) \
+                == router._metrics.failover_counts()["resumed"]
+            # The killed replica left membership without drain...
+            assert router.counts() == {"ready": 1, "warming": 0,
+                                       "draining": 0, "dead": 0}
+        finally:
+            router.shutdown()
+        # ...and left its post-mortem: the flight-recorder dump names
+        # the in-flight streams the failover plane had to resume.
+        dumps = glob.glob(str(tmp_path / "hvd_flightrec.rank*.json"))
+        assert dumps, "killed replica left no flight-recorder dump"
+        events = json.loads(open(dumps[0]).read())["events"]
+        crash = [e for e in events if e["kind"] == "serve_crash"]
+        assert crash and crash[0]["replica"] == "r1"
+        assert crash[0]["inflight"], "post-mortem names no in-flight stream"
