@@ -256,14 +256,28 @@ def _build_gen_engine(args):
             reg.load(name, tree)
         return reg
 
-    if args.replicas > 1 or args.autoscale:
+    if args.replicas > 1 or args.autoscale or args.replica_procs:
         # Fleet mode: N replicas (each its own slots/block pool — and
         # its own adapter table — over the SHARED read-only params)
         # behind one FleetRouter. --autoscale starts at --min-replicas
         # and lets the queue-depth control loop grow toward --replicas;
-        # static fleets warm all N up front.
-        factory = lambda name: serve.GenerationEngine(  # noqa: E731
-            params, cfg, gcfg, adapters=_registry())
+        # static fleets warm all N up front. --replica-procs swaps the
+        # thread-engine factory for subprocess workers — each child
+        # re-derives the SAME params from the spec's seed, so stream
+        # digests stay comparable across topologies.
+        if args.replica_procs:
+            import dataclasses
+            spec = {
+                "model": dict(_GEN_MODEL, dtype="float32",
+                              unembed_dtype="float32",
+                              attn_backend="xla"),
+                "seed": 0,
+                "generation": dataclasses.asdict(gcfg),
+            }
+            factory = serve.spawn_replica_factory(spec)
+        else:
+            factory = lambda name: serve.GenerationEngine(  # noqa: E731
+                params, cfg, gcfg, adapters=_registry())
         initial = args.min_replicas if args.autoscale else args.replicas
         eng = serve.FleetRouter(
             factory=factory, initial=initial,
@@ -422,10 +436,12 @@ def run_gen_point(eng, qps: float, duration: float,
         "adapters": args.adapters,
         "adapter_mix": dict(zip(tenants, weights)),
         "adapter_only": args.adapter_only or None,
-        # Traffic shape + injected faults, so a digest-bearing row is
-        # self-describing about what produced it.
+        # Traffic shape + injected faults + replica topology, so a
+        # digest-bearing row is self-describing about what produced it
+        # (cross-topology digest comparison = grep topology + digest).
         "temperature": args.temperature,
         "chaos": args.chaos or None,
+        "topology": "process" if args.replica_procs else "thread",
         "tenant_sent": sent_by_tenant,
         "tenant_completed": done_by_tenant,
         "stream_digests": {t: _stream_digest(s)
@@ -578,6 +594,16 @@ def main():
                    help="[generate] engine replicas behind one "
                         "FleetRouter (static fleet; with --autoscale "
                         "this is the GROW CEILING instead)")
+    p.add_argument("--replica-procs", action="store_true",
+                   help="[generate] run each fleet replica as a "
+                        "SUBPROCESS worker (python -m horovod_tpu.serve."
+                        "proc_replica) behind a ProcReplicaClient, "
+                        "instead of an in-process engine thread — the "
+                        "same seeded traffic then exercises the serving "
+                        "plane across a real process boundary; every "
+                        "JSON row stamps topology: 'process' so digest "
+                        "comparisons across topologies are one grep "
+                        "(docs/inference.md 'Process replicas')")
     p.add_argument("--autoscale", action="store_true",
                    help="[generate] start at --min-replicas and let the "
                         "queue-depth FleetAutoscaler grow/shrink the "
@@ -626,6 +652,13 @@ def main():
         p.error("--adapters must be >= 0")
     if args.adapters and args.mode != "generate":
         p.error("--adapters applies to --mode generate only")
+    if args.replica_procs:
+        if args.mode != "generate":
+            p.error("--replica-procs applies to --mode generate only")
+        if args.adapters:
+            p.error("--replica-procs does not support --adapters: the "
+                    "subprocess replica spec carries no adapter tables "
+                    "(multi-tenant serving stays in-process for now)")
     if args.temperature < 0:
         p.error("--temperature must be >= 0 (0 = greedy)")
     if args.top_k < 0:
@@ -642,10 +675,19 @@ def main():
             p.error(str(e))
         if not any(f.target == "serve" for f in clauses):
             p.error(f"--chaos {args.chaos!r} has no serving-plane clause "
-                    f"(replica_kill= / replica_hang= / slow_step=) — "
-                    f"training-plane drills belong to tpurun, not the "
-                    f"bench")
-        if any(f.action in ("replica_kill", "replica_hang")
+                    f"(replica_kill= / replica_hang= / "
+                    f"replica_proc_kill= / slow_step=) — training-plane "
+                    f"drills belong to tpurun, not the bench")
+        if any(f.action == "replica_proc_kill" for f in clauses) \
+                and not args.replica_procs:
+            # In a thread fleet the clause would fire inside THIS
+            # process's engine loop and SIGKILL the whole bench — the
+            # drill only means anything when the victim is a child.
+            p.error("--chaos replica_proc_kill needs --replica-procs: "
+                    "the clause SIGKILLs the replica's own PROCESS, "
+                    "which in a thread fleet is the bench itself")
+        if any(f.action in ("replica_kill", "replica_hang",
+                            "replica_proc_kill")
                for f in clauses) \
                 and args.replicas <= 1 and not args.autoscale:
             # A bare engine's serve_name stays "engine" — a clause
@@ -743,6 +785,7 @@ def _fleet_settle(eng, args, lost_streams: int, streams_by_tenant=None):
         "failover": snap["fleet"]["failover_total"],
         "stranded": snap["fleet"]["streams_stranded_total"],
         "chaos": args.chaos or None,
+        "topology": "process" if args.replica_procs else "thread",
     }
     if streams_by_tenant is not None:
         # Per-tenant digest map over the WHOLE run (all operating

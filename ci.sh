@@ -260,6 +260,68 @@ print("post-mortem OK: dead replica dumped its in-flight streams")
 print("SERVING FAILOVER OK")
 PYEOF
 
+echo "== out-of-process replicas: SIGKILL a subprocess replica mid-stream -> cross-process failover =="
+# ISSUE 16 acceptance: the fault-tolerance plane crossed a real process
+# boundary. A 3-replica SUBPROCESS fleet (each member a `python -m
+# horovod_tpu.serve.proc_replica` worker behind a ProcReplicaClient)
+# takes the same seeded traffic as a thread fleet; the chaos clause
+# SIGKILLs r1's worker process mid-stream (a dead pid, not a flipped
+# flag). Pinned: zero lost streams, >=1 failover resume, and every
+# client-visible stream digest IDENTICAL to the unkilled THREAD-fleet
+# reference — bit-identity across both topologies and a real SIGKILL.
+# The dead child leaves its serve_crash post-mortem in its PER-REPLICA
+# dump dir ($FR_PROC/r1), written before the SIGKILL lands.
+rm -f /tmp/hvd_proc_tref.json /tmp/hvd_proc_kill.json
+FR_PROC="$(mktemp -d)"
+export FR_PROC
+run_cpu timeout -k 10 420 python bin/serve_bench.py --mode generate \
+  --qps 60 --duration 3 --deadline-ms 0 --slots 2 --gen-tokens 32 \
+  --replicas 3 --json /tmp/hvd_proc_tref.json
+HVD_FLIGHTREC_DIR="$FR_PROC" \
+run_cpu timeout -k 10 420 python bin/serve_bench.py --mode generate \
+  --qps 60 --duration 3 --deadline-ms 0 --slots 2 --gen-tokens 32 \
+  --replicas 3 --replica-procs \
+  --chaos 'replica_proc_kill=r1@stream=3' --json /tmp/hvd_proc_kill.json
+python - <<'PYEOF'
+import glob, json, os
+def rows(path):
+    return [json.loads(l) for l in open(path)]
+ref = [r for r in rows("/tmp/hvd_proc_tref.json")
+       if "stream_digest" in r][-1]
+kill_rows = rows("/tmp/hvd_proc_kill.json")
+row = [r for r in kill_rows if "stream_digest" in r][-1]
+fleet = [r for r in kill_rows if r.get("fleet")][-1]
+# The topology stamp makes the cross-topology comparison self-checking.
+assert ref["topology"] == "thread" and row["topology"] == "process", \
+    (ref.get("topology"), row.get("topology"))
+assert row["completed"] == row["sent"] and row["failed"] == 0, \
+    (row["completed"], row["sent"], row["failed"])
+assert row["overload_drops"] == 0 and row["deadline_drops"] == 0, row
+assert fleet["failover"]["resumed"] >= 1, fleet["failover"]
+assert fleet["failover"]["exhausted"] == 0, fleet["failover"]
+assert fleet["stranded"] >= 1, fleet
+assert fleet["drained_lost_streams"] == 0, fleet
+# The SIGKILL actually landed on a member (its dispatch history folded
+# into the bounded "retired" series when the dead pid was evicted).
+assert fleet["dispatch"].get("retired", 0) >= 1, fleet
+assert row["stream_digests"] == ref["stream_digests"], \
+    "process-kill failover changed a client-visible token stream vs " \
+    "the thread-fleet reference"
+print(f"proc fleet: {fleet['stranded']} stranded -> "
+      f"{fleet['failover']['resumed']} resumed, 0 exhausted; digests "
+      f"identical to the unkilled thread fleet")
+# The dead CHILD's post-mortem: per-replica dump dir, serve_crash event
+# naming the in-flight streams, written before the self-SIGKILL.
+dumps = glob.glob(os.environ["FR_PROC"] + "/r1/hvd_flightrec.rank*.json")
+assert dumps, "SIGKILLed child left no flight-recorder post-mortem"
+body = open(dumps[0]).read()
+assert "serve_crash" in body and "replica_proc_kill" in body, \
+    f"child post-mortem names neither the crash nor the drill: {body[:200]}"
+print("post-mortem OK: dead child dumped its in-flight streams before "
+      "the SIGKILL")
+print("OUT-OF-PROCESS FAILOVER OK")
+PYEOF
+
 echo "== multi-tenant adapters: hot-evict under traffic (refusal while referenced, zero lost streams) =="
 run_cpu timeout -k 10 240 python - <<'PYEOF'
 import time

@@ -71,6 +71,22 @@ class WorkerFailureError(TransportError):
     """
 
 
+class ReplicaTimeoutError(TransportError):
+    """A subprocess serving replica did not answer within its transport
+    timeout.
+
+    Raised by :class:`horovod_tpu.serve.proc_replica.ProcReplicaClient`
+    when an HTTP round trip to the child worker times out (connect or
+    read). Deliberately a *distinct* class from generic transport
+    failures: :meth:`horovod_tpu.serve.router.ReplicaHandle.load` maps
+    any other stats-surface exception to the ``1 << 30`` busy sentinel
+    (route around it and move on), but a TIMEOUT means the child may be
+    hung — the handle marks itself suspect and runs an immediate
+    liveness check so a wedged process is evicted within one poll
+    instead of being dispatch-demoted forever.
+    """
+
+
 class ServerOverloadedError(HorovodError):
     """The inference server's admission queue is full.
 

@@ -25,10 +25,11 @@ with the TTFT quantile estimated from the fleet-summed
 
 from __future__ import annotations
 
+import json
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .registry import parse_exposition
 
@@ -115,6 +116,41 @@ class FleetPoller:
             out.append(summed)
         return out
 
+    def _advertised_endpoints(self) -> List[Tuple[str, int]]:
+        """Subprocess-replica ``/metrics`` endpoints advertised by the
+        scraped router's ``/healthz`` ``replica_metrics`` breakdown
+        (``{name: "host:port"}``) — a subprocess fleet's samples live at
+        the CHILDREN's endpoints (the router deliberately does not relay
+        them), so a summary that only reads the router's port would
+        report a process fleet as generating nothing. A not-ready
+        ``/healthz`` answers 503 with the same JSON body; read it
+        through the HTTPError. Deduplicated, order-stable."""
+        seen: Dict[Tuple[str, int], None] = {}
+        for r in self.ranks():
+            url = f"http://{self.host}:{self.base_port + r}/healthz"
+            try:
+                with urllib.request.urlopen(url,
+                                            timeout=self.timeout) as resp:
+                    text = resp.read()
+            except urllib.error.HTTPError as e:
+                try:
+                    text = e.read()
+                except (OSError, ValueError):
+                    continue
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+            try:
+                body = json.loads(text.decode("utf-8", "replace"))
+            except ValueError:
+                continue
+            for ep in (body.get("replica_metrics") or {}).values():
+                host, _, port = str(ep).rpartition(":")
+                try:
+                    seen.setdefault((host or self.host, int(port)), None)
+                except ValueError:
+                    continue
+        return list(seen)
+
     def _serving_line(self, now: float, totals: Dict[str, float]) -> str:
         """The serving-fleet flavor of :meth:`line`: a scrape that
         carries ``hvd_fleet_replicas`` is a :class:`~horovod_tpu.serve.
@@ -135,6 +171,20 @@ class FleetPoller:
         for parsed in labeled:
             for key, v in (parsed or {}).items():
                 merged[key] = merged.get(key, 0.0) + v
+        # Subprocess fleets: walk each child endpoint the router's
+        # /healthz advertises — ONE scrape per endpoint per poll (the
+        # PR-14 rule), folded into BOTH views of this poll (`merged`
+        # feeds the labeled breakdowns, `totals` feeds the rate deltas
+        # and becomes `_prev`, so the walk must land in each or
+        # tokens/s would read zero forever on a process fleet).
+        for host, port in self._advertised_endpoints():
+            child = scrape_exposition(host, port, self.timeout)
+            if child is None:
+                continue
+            for key, v in child.items():
+                merged[key] = merged.get(key, 0.0) + v
+                name = key[0]
+                totals[name] = totals.get(name, 0.0) + v
         states = {dict(labels).get("state"): v
                   for (name, labels), v in merged.items()
                   if name == "hvd_fleet_replicas"}

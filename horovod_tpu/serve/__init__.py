@@ -41,6 +41,18 @@ a queue-depth autoscaler closing the loop
     router.warmup()
     serve.FleetAutoscaler(router, min_replicas=2, max_replicas=8).start()
     serve.HttpServer(generate=router).start()
+
+Replicas can also live OUT of process: :func:`~.proc_replica.
+spawn_replica_factory` builds each member as a subprocess worker
+(``python -m horovod_tpu.serve.proc_replica``) fronted by a
+:class:`~.proc_replica.ProcReplicaClient` that duck-types the engine
+surface over HTTP, so spawn/warm/drain/evict, the autoscaler, and
+stream failover all work unchanged across the process boundary
+(docs/inference.md "Process replicas"):
+
+    factory = serve.spawn_replica_factory({"model": {...}, "seed": 0,
+                                           "generation": {...}})
+    router = serve.FleetRouter(factory=factory, initial=3)
 """
 
 from .adapters import AdapterRegistry  # noqa: F401
@@ -93,6 +105,21 @@ from ..parallel.transformer import (  # noqa: F401
 from ..exceptions import (  # noqa: F401
     DeadlineExceededError,
     FailoverExhaustedError,
+    ReplicaTimeoutError,
     ServerClosedError,
     ServerOverloadedError,
 )
+
+_PROC_REPLICA_NAMES = ("ProcReplicaClient", "spawn_replica_factory")
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): `python -m horovod_tpu.serve.proc_replica` — the
+    # worker entrypoint — imports this package first, and an eager
+    # `from .proc_replica import ...` here would put the module in
+    # sys.modules before runpy executes it as __main__ (double
+    # execution + RuntimeWarning in every spawned child).
+    if name in _PROC_REPLICA_NAMES:
+        from . import proc_replica
+        return getattr(proc_replica, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -38,7 +38,13 @@ plane (:func:`heartbeat_liveness`) — the fleet never grows a second
 liveness protocol. Thread replicas ride the engine's own in-process
 probe (:meth:`~.generate.GenerationEngine.loop_alive`), which reads
 dead on loop-thread death AND on a wedged loop (work pending, no
-completed iteration inside the stall window).
+completed iteration inside the stall window). Subprocess replicas
+(:class:`~.proc_replica.ProcReplicaClient`) answer the SAME
+``loop_alive`` probe, so the handle plumbing is unchanged: a dead pid
+(``proc.poll()``) reads dead within one membership poll — no heartbeat
+wait — and an unreachable-but-running child is declared dead on the
+two-strike ``/healthz`` rule (one strike once a transport timeout on
+the stats surface marked it suspect).
 
 The failover interplay (ISSUE 15): every :meth:`poll_once` starts with
 :meth:`~.router.FleetRouter.poll`, whose eviction of a liveness-dead

@@ -51,7 +51,9 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import queue as std_queue
+import signal
 import threading
 import time
 from collections import deque
@@ -913,6 +915,14 @@ class GenerationEngine(ReadinessMixin):
                     # the post-mortem a real dead replica would leave.
                     self._crash_dump("fault injection: replica_kill")
                     return
+                if act == "proc_kill":
+                    # Real process death: dump the post-mortem first
+                    # (SIGKILL gives no atexit), then SIGKILL ourselves
+                    # — the parent-side client sees a dead pid and
+                    # broken streams, exactly what a crashed subprocess
+                    # replica leaves behind.
+                    self._crash_dump("fault injection: replica_proc_kill")
+                    os.kill(os.getpid(), signal.SIGKILL)
                 if act == "hang":
                     # Park forever with the thread ALIVE: only the
                     # stale-beat half of loop_alive() can catch this.

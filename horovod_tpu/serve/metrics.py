@@ -538,6 +538,9 @@ class FleetMetrics:
         # residency report, the counter on the first adapter dispatch).
         self._g_adapters = None
         self._c_adapter_dispatch = None
+        # Subprocess-replica gauge, LAZY too: a thread-only fleet never
+        # exposes it (registers on the first nonzero count).
+        self._g_procs = None
         self._replica_names: List[str] = []
         self._retired_names: set = set()
         # One lock over the dispatch-fold composite: read-value + remove
@@ -591,6 +594,19 @@ class FleetMetrics:
                 "hvd_fleet_adapters_resident",
                 "Distinct LoRA adapters resident across live replicas")
         self._g_adapters.set(int(count or 0))
+
+    def set_replica_procs(self, count: int) -> None:
+        """Refresh ``hvd_fleet_replica_procs`` — live members backed by
+        a subprocess worker (engines exposing a ``pid``). Lazy like the
+        adapter gauge: a thread-only fleet never exposes the series, so
+        its presence on a dashboard IS the topology signal."""
+        if count <= 0 and self._g_procs is None:
+            return
+        if self._g_procs is None:
+            self._g_procs = self.registry.gauge(
+                "hvd_fleet_replica_procs",
+                "Fleet members backed by a subprocess replica worker")
+        self._g_procs.set(int(count))
 
     def on_adapter_dispatch(self, outcome: str) -> None:
         """One adapter-carrying dispatch:

@@ -80,8 +80,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..exceptions import (DeadlineExceededError, FailoverExhaustedError,
-                          ServerClosedError, ServerOverloadedError,
-                          WorkerFailureError)
+                          ReplicaTimeoutError, ServerClosedError,
+                          ServerOverloadedError, WorkerFailureError)
 from ..obs import flightrec
 from .generate import GenerationHandle
 from .metrics import FleetMetrics
@@ -158,9 +158,28 @@ class ReplicaHandle:
     def load(self) -> int:
         """Dispatch pressure: queued + executing rows — the same number
         this replica's ``/metrics`` exports (``hvd_queue_depth`` +
-        ``hvd_active_slots``)."""
+        ``hvd_active_slots``).
+
+        A generic stats-surface failure reads as the busy sentinel
+        (route around it; the liveness plane owns the dead verdict on
+        its own cadence). A transport TIMEOUT is different: for a
+        subprocess replica it means the child may be HUNG, and the busy
+        sentinel alone would route around a wedged process forever —
+        so the handle marks the engine suspect and runs an immediate
+        liveness check, turning a hung child into a dead handle within
+        one poll."""
         try:
             return int(self.engine.load())
+        except ReplicaTimeoutError:
+            suspect = getattr(self.engine, "mark_suspect", None)
+            if callable(suspect):
+                try:
+                    suspect()
+                except Exception:  # noqa: BLE001 — advisory only
+                    pass
+            if not self.alive():
+                self._dead = True
+            return 1 << 30
         except Exception:  # noqa: BLE001 — a dying replica reads as busy
             return 1 << 30
 
@@ -588,9 +607,32 @@ class FleetRouter:
                 names.update(res)
         return len(names) if any_registry else None
 
+    def replica_metrics_endpoints(self) -> Dict[str, str]:
+        """``{replica name: "host:port"}`` for every member whose engine
+        serves its OWN ``/metrics`` (subprocess replicas). Advertised in
+        the router's ``/healthz`` so a scraper
+        (:class:`horovod_tpu.obs.summary.FleetPoller`) can walk the
+        children directly — federation, not proxying: the child samples
+        are never relayed through the router's own render."""
+        out: Dict[str, str] = {}
+        for h in self.replicas():
+            fn = getattr(h.engine, "metrics_endpoint", None)
+            if not callable(fn):
+                continue
+            try:
+                ep = fn()
+            except Exception:  # noqa: BLE001 — booting/dying child = none
+                continue
+            if ep:
+                out[h.name] = str(ep)
+        return out
+
     def _refresh_gauges(self) -> None:
         self._metrics.set_replicas(self.counts())
         self._metrics.set_adapters_resident(self.adapters_resident())
+        self._metrics.set_replica_procs(
+            sum(1 for h in self.replicas()
+                if getattr(h.engine, "pid", None) is not None))
 
     # -- dispatch ----------------------------------------------------------
 

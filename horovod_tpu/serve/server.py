@@ -138,6 +138,16 @@ class _Handler(BaseHTTPRequestHandler):
                 fleet_health = getattr(eng, "fleet_health", None)
                 if callable(fleet_health):
                     body["replicas"] = fleet_health()
+                    # Subprocess members serve their own /metrics; the
+                    # router never relays those samples (federation, not
+                    # proxying), so advertise the endpoints here for a
+                    # scraper (FleetPoller) to walk — one scrape per
+                    # endpoint per poll.
+                    eps = getattr(eng, "replica_metrics_endpoints", None)
+                    if callable(eps):
+                        endpoints = eps()
+                        if endpoints:
+                            body["replica_metrics"] = endpoints
                     break
             # Adapter-table residency (multi-tenant serving): how many
             # fine-tunes this endpoint can serve right now. Engines and

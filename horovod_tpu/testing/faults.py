@@ -20,6 +20,8 @@ This module turns an env spec into precise failures:
     HVD_FAULT_SPEC=replica_kill=r1@stream=3    # serving: kill replica r1's
                                                #   engine loop at its 3rd stream
     HVD_FAULT_SPEC=replica_hang=r0@stream=2    # serving: hang the loop instead
+    HVD_FAULT_SPEC=replica_proc_kill=r1@stream=3  # serving: SIGKILL the
+                                               #   subprocess replica's worker
     HVD_FAULT_SPEC=slow_step=50                # serving: 50 ms per decode step
 
 Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>``,
@@ -27,7 +29,8 @@ Grammar: comma-separated clauses, each ``rank=<r>:<action>@step=<s>``,
 ``ckpt:<truncate|flip|drop_marker>@step=<s>``,
 ``resize:<shrink|grow|world>=<k>@step=<s>``, or a serving-plane clause
 ``replica_kill=<name>@stream=<k>`` / ``replica_hang=<name>@stream=<k>``
-/ ``slow_step=<ms>``. Step-scoped actions
+/ ``replica_proc_kill=<name>@stream=<k>`` / ``slow_step=<ms>``.
+Step-scoped actions
 REQUIRE ``@step`` (a clause that could never fire is rejected loudly);
 ``delay_ms`` is unconditional — it has no step context and rejects
 ``@step``. Every clause takes an optional ``@epoch=<e>`` suffix
@@ -42,15 +45,21 @@ integrity manifests + verified fallback restore exist for. They fire on
 every rank (each env-world rank owns a private checkpoint copy).
 
 Serving-plane clauses (``replica_kill`` / ``replica_hang`` /
-``slow_step``) fire inside a :class:`horovod_tpu.serve.generate.
-GenerationEngine` loop — the chaos analog of a serving replica dying,
-wedging, or running slow under load. Replicas are in-process loop
-threads, so "kill" is an abrupt loop-thread death (the thread exits
-WITHOUT failing its handles — a crashed process cannot deliver
-failures; the stranded streams are exactly what the fleet router's
-deterministic failover must resume) and "hang" parks the loop forever
-with heartbeats-of-a-sort still flowing (the thread stays alive — only
-the in-process liveness probe's stale-beat verdict can catch it).
+``replica_proc_kill`` / ``slow_step``) fire inside a
+:class:`horovod_tpu.serve.generate.GenerationEngine` loop — the chaos
+analog of a serving replica dying, wedging, or running slow under
+load. For thread replicas "kill" is an abrupt loop-thread death (the
+thread exits WITHOUT failing its handles — a crashed process cannot
+deliver failures; the stranded streams are exactly what the fleet
+router's deterministic failover must resume) and "hang" parks the loop
+forever with heartbeats-of-a-sort still flowing (the thread stays
+alive — only the in-process liveness probe's stale-beat verdict can
+catch it). ``replica_proc_kill`` is the out-of-process analog: the
+engine loop dumps its post-mortem and then SIGKILLs its OWN process —
+only meaningful inside a :mod:`horovod_tpu.serve.proc_replica` worker
+(the clause reaches the child because spawned workers inherit the
+parent environment), where the parent-side liveness plane must detect
+the dead pid and failover-replay the child's streams.
 ``@stream=<k>`` scopes the trigger to the replica's k-th ADMITTED
 stream, so the kill always lands mid-stream, deterministically.
 ``slow_step=<ms>`` sleeps in every engine loop iteration on EVERY
@@ -110,7 +119,8 @@ _ACTIONS = ("kill", "exit", "hang", "mute", "delay_ms",
             "shrink", "grow", "world")
 _CKPT_ACTIONS = ("truncate", "flip", "drop_marker")
 _RESIZE_ACTIONS = ("shrink", "grow", "world")
-_SERVE_ACTIONS = ("replica_kill", "replica_hang", "slow_step")
+_SERVE_ACTIONS = ("replica_kill", "replica_hang", "replica_proc_kill",
+                  "slow_step")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,7 +141,9 @@ class FaultSpecError(ValueError):
 
 def _parse_serve_clause(clause: str) -> Fault:
     """One serving-plane clause: ``replica_kill=<name>@stream=<k>`` /
-    ``replica_hang=<name>@stream=<k>`` / ``slow_step=<ms>`` — same
+    ``replica_hang=<name>@stream=<k>`` /
+    ``replica_proc_kill=<name>@stream=<k>`` (real SIGKILL of a
+    subprocess replica's worker) / ``slow_step=<ms>`` — same
     loud-validation standard as the training-plane grammar (a drill
     that could never fire is a spec bug, not a no-op)."""
     parts = clause.split("@")
@@ -529,9 +541,12 @@ def serve_hook(replica: str, streams_admitted: int) -> Optional[str]:
     GenerationEngine` loop iteration (near-zero-cost no-op unless the
     spec has a serve clause). Returns ``"kill"`` (the loop must die
     abruptly, stranding its handles — the deterministic-failover drill
-    shape), ``"hang"`` (the loop must park forever with its thread
-    alive — only a stale-beat liveness probe catches it), or None.
-    ``slow_step`` clauses sleep here directly, every call.
+    shape), ``"proc_kill"`` (the loop must SIGKILL its OWN process
+    after dumping a post-mortem — the subprocess-replica drill: the
+    parent sees a dead pid, not a flipped flag), ``"hang"`` (the loop
+    must park forever with its thread alive — only a stale-beat
+    liveness probe catches it), or None. ``slow_step`` clauses sleep
+    here directly, every call.
 
     ``streams_admitted`` is the replica's cumulative count of streams
     admitted into decode slots; a ``@stream=k`` clause fires once that
@@ -559,7 +574,8 @@ def serve_hook(replica: str, streams_admitted: int) -> Optional[str]:
                          stream=f.stream)
         print(f"[faults] serving replica {replica}: {f.action} at "
               f"admitted stream {f.stream} (epoch {epoch})", flush=True)
-        out = "kill" if f.action == "replica_kill" else "hang"
+        out = {"replica_kill": "kill",
+               "replica_proc_kill": "proc_kill"}.get(f.action, "hang")
     return out
 
 

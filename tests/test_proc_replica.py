@@ -1,0 +1,460 @@
+"""Out-of-process replica plumbing (ISSUE 16): ProcReplicaClient
+transport semantics, the router's dead-pid/suspect verdicts, the
+``replica_proc_kill`` grammar, and the FleetPoller's child-endpoint
+walk.
+
+Everything here runs against IN-PROCESS fakes — scripted HTTP/socket
+servers standing in for the subprocess worker — per the ROADMAP tier-1
+budget note: a real ``python -m horovod_tpu.serve.proc_replica`` child
+costs a jax import + compile, so subprocess drills (spawn, SIGKILL,
+cross-process digest identity) live in ci.sh, and this file pins the
+client/router CONTRACTS at milliseconds each:
+
+* connect refusal on submit → retryable overload, with the retry budget
+  bounded (never a silent loss, never an unbounded storm);
+* a mid-body disconnect on submit → overload with NO stream recorded as
+  admitted (a 200 status line is the only admission receipt);
+* ``shutdown(drain=True)`` waits for the streams this client is still
+  relaying;
+* the router evicts a dead-pid replica WITHOUT drain;
+* a transport timeout on the stats surface marks the handle suspect and
+  a hung child reads dead in one liveness check.
+"""
+
+import http.server
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.exceptions import (DeadlineExceededError,
+                                    ReplicaTimeoutError, ServerClosedError,
+                                    ServerOverloadedError,
+                                    WorkerFailureError)
+from horovod_tpu.serve.proc_replica import ProcReplicaClient
+from horovod_tpu.serve.router import FleetRouter, ReplicaHandle
+from horovod_tpu.testing import faults
+
+
+def _client(port, **kw):
+    kw.setdefault("connect_timeout_s", 1.0)
+    kw.setdefault("read_timeout_s", 5.0)
+    kw.setdefault("probe_timeout_s", 0.3)
+    kw.setdefault("backoff_s", 0.001)
+    return ProcReplicaClient("r0", None, port=port, **kw)
+
+
+def _free_port():
+    """A port with NOTHING listening: bind, grab, close."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _ScriptedHandler(http.server.BaseHTTPRequestHandler):
+    """Plays whatever its server's ``script`` callable says; the fake
+    subprocess worker."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        self.server.script(self, self.path)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"{}")
+        self.server.script(self, self.path, body)
+
+    def reply_json(self, status, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def stream_lines(self, lines, delay_s=0.0):
+        """The worker's chunked /generate shape: 200 + one JSON line
+        per event."""
+        self.send_response(200)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for obj in lines:
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+            if delay_s:
+                time.sleep(delay_s)
+
+
+@pytest.fixture
+def scripted():
+    """One scripted HTTP server per test: yields ``(port, set_script)``
+    and tears the listener down afterwards."""
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                          _ScriptedHandler)
+    srv.daemon_threads = True
+    srv.script = lambda h, path, body=None: h.reply_json(404, {})
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1], lambda fn: setattr(srv, "script", fn)
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestSubmitTransport:
+    def test_connect_refusal_maps_to_bounded_overload(self, monkeypatch):
+        c = _client(_free_port(), submit_retries=2)
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        with pytest.raises(ServerOverloadedError) as ei:
+            c.submit([1, 2, 3])
+        # The overload path carries a backoff hint — the router's
+        # dispatch loop honors it — and the retry budget is BOUNDED:
+        # exactly submit_retries backoff sleeps, then the verdict.
+        assert ei.value.retry_after_ms > 0
+        assert len(sleeps) == 2
+        assert not c._inflight
+
+    def test_mid_body_disconnect_admits_nothing(self):
+        # The fake worker reads the full request then drops the
+        # connection before any status line — the request WAS sent, so
+        # the client must NOT blind-retry (the worker may hold the
+        # stream) and must NOT record an admitted stream: overload,
+        # exactly one connection attempt.
+        accepted = []
+
+        def _server(sock):
+            while True:
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return
+                accepted.append(1)
+                try:
+                    conn.settimeout(2.0)
+                    while b"\r\n\r\n" not in conn.recv(65536):
+                        pass
+                except OSError:
+                    pass
+                conn.close()
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(4)
+        threading.Thread(target=_server, args=(sock,),
+                         daemon=True).start()
+        try:
+            c = _client(sock.getsockname()[1], submit_retries=3)
+            with pytest.raises(ServerOverloadedError):
+                c.submit([1, 2, 3], max_new_tokens=4)
+            assert len(accepted) == 1
+            assert not c._inflight
+        finally:
+            sock.close()
+
+    def test_status_mapping(self, scripted):
+        port, set_script = scripted
+        c = _client(port)
+        cases = [
+            (503, {"error": "full", "retryable": True,
+                   "retry_after_ms": 250.0}, ServerOverloadedError),
+            (503, {"error": "closed", "retryable": False},
+             ServerClosedError),
+            (504, {"error": "late"}, DeadlineExceededError),
+            (400, {"error": "bad tokens"}, ValueError),
+            (500, {"error": "boom"}, WorkerFailureError),
+        ]
+        for status, body, exc in cases:
+            set_script(lambda h, p, b=None, s=status, o=body:
+                       h.reply_json(s, o))
+            with pytest.raises(exc):
+                c.submit([1])
+        assert not c._inflight
+
+    def test_overload_hint_relayed_from_worker(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.reply_json(
+            503, {"error": "full", "retryable": True,
+                  "retry_after_ms": 321.0}))
+        with pytest.raises(ServerOverloadedError) as ei:
+            _client(port).submit([1])
+        assert ei.value.retry_after_ms == 321.0
+
+    def test_stream_relays_tokens_and_done(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.stream_lines([
+            {"token": 5}, {"token": 6},
+            {"tokens": [5, 6], "finish_reason": "length", "n_tokens": 2,
+             "done": True}]))
+        c = _client(port)
+        h = c.submit([4], max_new_tokens=2)
+        r = h.result(timeout=5)
+        assert r["tokens"] == [5, 6] and r["finish_reason"] == "length"
+        assert h._tokens == [5, 6]
+        deadline = time.monotonic() + 2
+        while c._inflight and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not c._inflight
+
+    def test_midstream_disconnect_fails_handle_as_worker_failure(
+            self, scripted):
+        # Tokens flowed, then the transport died before the done line —
+        # the WorkerFailureError verdict is what the router's pump
+        # converts into a failover replay.
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.stream_lines([{"token": 9}]))
+        h = _client(port).submit([8])
+        with pytest.raises(WorkerFailureError):
+            h.result(timeout=5)
+        assert h._tokens == [9]
+
+    def test_deadline_error_line_stays_deadline(self, scripted):
+        # A deadline verdict inside the stream is the stream's OWN
+        # outcome — it must never be converted into the failover path.
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.stream_lines([
+            {"error": "DeadlineExceededError('late')", "done": True}]))
+        with pytest.raises(DeadlineExceededError):
+            _client(port).submit([1]).result(timeout=5)
+
+    def test_wire_protocol_carries_the_submit_kwargs(self, scripted):
+        port, set_script = scripted
+        seen = {}
+
+        def script(h, p, b=None):
+            seen.update(b)
+            h.stream_lines([{"tokens": [], "finish_reason": "length",
+                             "n_tokens": 0, "done": True}])
+        set_script(script)
+        from horovod_tpu.serve import SamplingParams
+        c = _client(port)
+        c.submit([1, 2], max_new_tokens=3, deadline_ms=500.0,
+                 sampling=SamplingParams(temperature=0.5, top_k=4,
+                                         seed=7),
+                 eos_id=None).result(timeout=5)
+        assert seen["tokens"] == [1, 2]
+        assert seen["max_new_tokens"] == 3
+        assert seen["deadline_ms"] == 500.0
+        assert (seen["temperature"], seen["top_k"], seen["seed"]) \
+            == (0.5, 4, 7)
+        # eos was EXPLICITLY passed (as None): the key must be present
+        # so the worker honors "no eos" instead of its config default.
+        assert "eos" in seen and seen["eos"] is None
+        # … and an omitted eos must keep the key OUT of the body.
+        seen.clear()
+        c.submit([3]).result(timeout=5)
+        assert "eos" not in seen and "max_new_tokens" not in seen
+
+
+class TestLifecycle:
+    def test_shutdown_drain_waits_for_inflight_streams(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.stream_lines(
+            [{"token": 1}, {"token": 2},
+             {"tokens": [1, 2], "finish_reason": "length", "n_tokens": 2,
+              "done": True}], delay_s=0.15))
+        c = _client(port)
+        h = c.submit([0])
+        t0 = time.monotonic()
+        c.shutdown(drain=True, timeout=10.0)
+        waited = time.monotonic() - t0
+        # The stream takes ~0.45 s of scripted delays; a drain that
+        # returned early would read done()=False here.
+        assert h.done()
+        assert waited >= 0.2
+        assert h.result(timeout=1)["tokens"] == [1, 2]
+        with pytest.raises(ServerClosedError):
+            c.submit([1])
+
+    def test_shutdown_without_drain_does_not_wait(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.stream_lines(
+            [{"token": 1}] * 8, delay_s=0.2))
+        c = _client(port)
+        c.submit([0])
+        t0 = time.monotonic()
+        c.shutdown(drain=False, timeout=10.0)
+        assert time.monotonic() - t0 < 1.0
+
+    def test_booting_client_reads_warming_not_dead(self):
+        # No ready file yet: health says booting, liveness says alive —
+        # add_replica's warmup gate (not an eviction) owns this phase.
+        c = ProcReplicaClient("r9", None, ready_file="/nonexistent/rf")
+        assert c.health() == (False, "booting", 0)
+        assert c.loop_alive() is True
+        with pytest.raises(ServerOverloadedError):
+            c.submit([1])
+
+
+class _FakeDeadProc:
+    """A Popen whose pid has exited."""
+    pid = 12345
+    returncode = -9
+
+    def poll(self):
+        return -9
+
+    def wait(self, timeout=None):
+        return -9
+
+
+class TestRouterIntegration:
+    def test_dead_pid_evicted_without_drain(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.reply_json(
+            200, {"status": "ok", "queue_depth": 0}))
+        c = _client(port)
+        router = FleetRouter(engines=[c], poll_interval_s=0)
+        assert router.counts()["ready"] == 1
+        calls = []
+        orig = c.shutdown
+        c.shutdown = lambda drain=True, timeout=30.0: (
+            calls.append(drain), orig(drain=drain, timeout=timeout))
+        c._proc = _FakeDeadProc()   # the child died: dead pid
+        router.poll()               # ONE poll → evicted, no drain
+        assert router.counts() == {"ready": 0, "warming": 0,
+                                   "draining": 0, "dead": 0}
+        deadline = time.monotonic() + 5
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert calls == [False]     # reaped via shutdown(drain=False)
+        router.shutdown()
+
+    def test_load_timeout_marks_suspect_and_evicts_in_one_check(self):
+        # A worker that ACCEPTS but never answers — the hung-child
+        # shape. load() must not just return the busy sentinel: the
+        # timeout marks the client suspect and runs the liveness check
+        # immediately, so the handle reads dead in THIS poll.
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(8)
+        try:
+            c = _client(sock.getsockname()[1], probe_timeout_s=0.2)
+            with pytest.raises(ReplicaTimeoutError):
+                c.load()
+            handle = ReplicaHandle("r0", c)
+            assert handle.load() == 1 << 30
+            assert c._suspect
+            assert handle.state() == "dead"
+        finally:
+            sock.close()
+
+    def test_generic_load_error_stays_busy_sentinel_not_dead(self, scripted):
+        # Connect REFUSAL on /stats is not a timeout: the busy sentinel
+        # demotes the replica for this dispatch, and the dead verdict
+        # stays with the liveness plane's own two-strike cadence.
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.reply_json(
+            200, {"status": "ok", "queue_depth": 0}))
+        c = _client(_free_port())
+        handle = ReplicaHandle("r0", c)
+        assert handle.load() == 1 << 30
+        assert not c._suspect
+
+    def test_router_advertises_child_metrics_endpoints(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.reply_json(
+            200, {"status": "ok", "queue_depth": 0}))
+        c = _client(port)
+        router = FleetRouter(engines=[c], poll_interval_s=0)
+        assert router.replica_metrics_endpoints() \
+            == {"r0": f"127.0.0.1:{port}"}
+        router.shutdown()
+
+    def test_stats_returns_last_known_snapshot_after_death(self, scripted):
+        # The retire fold reads stats() from a replica that may already
+        # be gone; the client answers with its last-known snapshot so
+        # final totals fold instead of zeroing.
+        port, set_script = scripted
+        set_script(lambda h, p, b=None: h.reply_json(
+            200, {"queue_depth": 1, "active_slots": 2,
+                  "requests_total": 7}))
+        c = _client(port)
+        assert c.load() == 3
+        c._port = _free_port()      # the child vanished
+        snap = c.stats()
+        assert snap["requests_total"] == 7
+        assert c._active_rows() == 2
+
+
+class TestProcKillGrammar:
+    def test_accepts_proc_kill_with_stream(self):
+        fs = faults.parse_spec("replica_proc_kill=r1@stream=3")
+        assert fs[0].action == "replica_proc_kill"
+        assert fs[0].name == "r1" and fs[0].stream == 3
+
+    def test_rejects_proc_kill_without_stream(self):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec("replica_proc_kill=r1")
+
+    def test_serve_hook_returns_proc_kill_verdict(self, monkeypatch):
+        monkeypatch.setenv("HVD_FAULT_SPEC",
+                           "replica_proc_kill=r1@stream=2")
+        faults.reset()
+        try:
+            assert faults.serve_hook("r0", 5) is None
+            assert faults.serve_hook("r1", 1) is None
+            assert faults.serve_hook("r1", 2) == "proc_kill"
+            assert faults.serve_hook("r1", 3) is None   # fires once
+        finally:
+            faults.reset()
+
+
+class TestPollerWalksChildren:
+    def test_fleet_line_sums_advertised_child_endpoints(self, scripted):
+        # The "router" endpoint carries the fleet gauge and advertises
+        # one child; the child carries the generation counters. The
+        # serving line must fold the child's samples into BOTH the
+        # labeled view (breakdowns) and the name-summed totals (rates).
+        from horovod_tpu.obs.summary import FleetPoller
+
+        child = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                _ScriptedHandler)
+        child.daemon_threads = True
+        child_port = child.server_address[1]
+
+        def child_script(h, path, body=None):
+            assert path == "/metrics"
+            data = (b"# TYPE hvd_tokens_generated_total counter\n"
+                    b"hvd_tokens_generated_total 128\n")
+            h.send_response(200)
+            h.send_header("Content-Length", str(len(data)))
+            h.end_headers()
+            h.wfile.write(data)
+        child.script = child_script
+        threading.Thread(target=child.serve_forever, daemon=True).start()
+
+        router_port, set_script = scripted
+
+        def router_script(h, path, body=None):
+            if path == "/healthz":
+                h.reply_json(200, {
+                    "status": "ok", "queue_depth": 0,
+                    "replica_metrics": {
+                        "r0": f"127.0.0.1:{child_port}"}})
+                return
+            data = (b"# TYPE hvd_fleet_replicas gauge\n"
+                    b'hvd_fleet_replicas{state="ready"} 1\n')
+            h.send_response(200)
+            h.send_header("Content-Length", str(len(data)))
+            h.end_headers()
+            h.wfile.write(data)
+        set_script(router_script)
+        try:
+            poller = FleetPoller("127.0.0.1", router_port, world=1,
+                                 timeout=2.0)
+            line = poller.line()
+            assert poller.last_mode == "serving"
+            assert "1/1 replicas ready" in line
+            # The child's counter landed in the rate baseline: without
+            # the walk, a process fleet's tokens/s would read 0 forever.
+            assert poller._prev["hvd_tokens_generated_total"] == 128.0
+        finally:
+            child.shutdown()
+            child.server_close()
