@@ -246,6 +246,7 @@ def _build_gen_engine(args):
         else:
             cache_bytes = slots * args.max_len * _GEN_BYTES_PER_TOKEN
     lora, adapter_trees = _bench_adapters(args, cfg)
+    spec_cfg = serve.SpecConfig(k=args.spec_k) if args.spec_k else None
 
     def _registry():
         if not adapter_trees:
@@ -274,15 +275,33 @@ def _build_gen_engine(args):
                 "seed": 0,
                 "generation": dataclasses.asdict(gcfg),
             }
+            if spec_cfg is not None:
+                spec["spec"] = spec_cfg.to_spec()
+            if adapter_trees:
+                # Seeds, not bytes: each child re-derives the SAME
+                # trees _bench_adapters built here (PRNGKey(100+i),
+                # b_scale=0.5), so per-tenant digests stay comparable
+                # across thread and subprocess topologies.
+                spec["adapters"] = {
+                    "rank": args.adapter_rank, "alpha": lora.alpha,
+                    "capacity": len(adapter_trees),
+                    "entries": [{"name": f"a{i}", "seed": 100 + i,
+                                 "b_scale": 0.5}
+                                for i in range(args.adapters)],
+                }
             factory = serve.spawn_replica_factory(spec)
         else:
             factory = lambda name: serve.GenerationEngine(  # noqa: E731
-                params, cfg, gcfg, adapters=_registry())
+                params, cfg, gcfg, adapters=_registry(), spec=spec_cfg)
         initial = args.min_replicas if args.autoscale else args.replicas
         eng = serve.FleetRouter(
             factory=factory, initial=initial,
+            # Subprocess children boot with EVERY tenant resident (the
+            # spec carries them), so the lazy-load path has nothing to
+            # do — and couldn't ship a host tree over HTTP anyway.
             adapter_source=(adapter_trees.__getitem__
-                            if adapter_trees else None))
+                            if adapter_trees and not args.replica_procs
+                            else None))
         eng.bench_cache_bytes = cache_bytes    # per REPLICA (pool grows
         t0 = time.monotonic()                  # with the fleet)
         warmed = eng.warmup()
@@ -298,13 +317,17 @@ def _build_gen_engine(args):
                 breach_up=2, breach_down=2,
                 cooldown_s=1.0, interval_s=0.25).start()
         return eng
-    eng = serve.GenerationEngine(params, cfg, gcfg, adapters=_registry())
+    eng = serve.GenerationEngine(params, cfg, gcfg, adapters=_registry(),
+                                 spec=spec_cfg)
     eng.bench_cache_bytes = cache_bytes      # stamped into the JSON rows
     t0 = time.monotonic()
     warmed = eng.warmup()
+    n_verify = sum(1 for k in warmed
+                   if isinstance(k, tuple) and k and k[0] == "verify")
     print(f"warmup [{args.kv_layout}, slots={slots}]: decode + "
-          f"{len(warmed) - 1} prefill buckets pre-compiled in "
-          f"{time.monotonic() - t0:.2f} s")
+          f"{len(warmed) - 1 - n_verify} prefill buckets"
+          f"{f' + {n_verify} verify' if n_verify else ''} "
+          f"pre-compiled in {time.monotonic() - t0:.2f} s")
     return eng
 
 
@@ -448,6 +471,13 @@ def run_gen_point(eng, qps: float, duration: float,
                            for t, s in streams_by_tenant.items()},
         "rejected_tenant_quota": snap.get("rejected_tenant_quota", 0),
         "tenants": snap.get("tenants") or {},
+        # Speculative-decoding fields — stamped in EVERY generate row
+        # (k=0 / None ratios when --spec-k is off) so consumers never
+        # key-error across modes. Cumulative over the engine's life,
+        # like the prefix counters above.
+        "spec_k": int(snap.get("spec_k") or 0),
+        "spec_accept_rate": (snap.get("spec") or {}).get("accept_rate"),
+        "tokens_per_step": (snap.get("spec") or {}).get("tokens_per_step"),
     }
     if snap.get("adapters_resident") is not None:
         row["adapters_resident"] = snap["adapters_resident"]
@@ -624,6 +654,13 @@ def main():
     p.add_argument("--top-k", type=int, default=0,
                    help="[generate, --temperature>0] top-k cutoff "
                         "(0 = full vocab)")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="[generate] speculative decoding: draft up to K "
+                        "tokens per decode step with the self-speculative "
+                        "n-gram drafter and score them in one verify "
+                        "forward (0 = off). Greedy streams stay digest-"
+                        "identical to a spec-off run; needs the gather "
+                        "decode path (incompatible with --paged-kernel)")
     p.add_argument("--chaos", default="",
                    help="[generate] serving-plane HVD_FAULT_SPEC clause(s) "
                         "armed for this run, e.g. "
@@ -652,13 +689,18 @@ def main():
         p.error("--adapters must be >= 0")
     if args.adapters and args.mode != "generate":
         p.error("--adapters applies to --mode generate only")
-    if args.replica_procs:
+    if args.replica_procs and args.mode != "generate":
+        p.error("--replica-procs applies to --mode generate only")
+    if args.spec_k < 0:
+        p.error("--spec-k must be >= 0 (0 = speculation off)")
+    if args.spec_k:
         if args.mode != "generate":
-            p.error("--replica-procs applies to --mode generate only")
-        if args.adapters:
-            p.error("--replica-procs does not support --adapters: the "
-                    "subprocess replica spec carries no adapter tables "
-                    "(multi-tenant serving stays in-process for now)")
+            p.error("--spec-k applies to --mode generate only")
+        if args.paged_kernel:
+            p.error("--spec-k needs the gather decode path: drop "
+                    "--paged-kernel (the Pallas kernel is allclose-"
+                    "pinned, not bitwise, so it cannot honor the "
+                    "spec-off greedy digest contract)")
     if args.temperature < 0:
         p.error("--temperature must be >= 0 (0 = greedy)")
     if args.top_k < 0:
@@ -786,6 +828,9 @@ def _fleet_settle(eng, args, lost_streams: int, streams_by_tenant=None):
         "stranded": snap["fleet"]["streams_stranded_total"],
         "chaos": args.chaos or None,
         "topology": "process" if args.replica_procs else "thread",
+        "spec_k": int(snap.get("spec_k") or 0),
+        "spec_accept_rate": (snap.get("spec") or {}).get("accept_rate"),
+        "tokens_per_step": (snap.get("spec") or {}).get("tokens_per_step"),
     }
     if streams_by_tenant is not None:
         # Per-tenant digest map over the WHOLE run (all operating
@@ -841,6 +886,12 @@ def run_generate(args):
         if args.json:
             with open(args.json, "a") as f:
                 f.write(json.dumps(fleet_row) + "\n")
+    if args.spec_k:
+        sp = eng.stats().get("spec") or {}
+        ar, tps = sp.get("accept_rate"), sp.get("tokens_per_step")
+        print(f"spec: k={args.spec_k}"
+              f" accept_rate={ar if ar is None else round(ar, 4)}"
+              f" tokens_per_step={tps if tps is None else round(tps, 3)}")
     eng.shutdown()
     if dropped_in_deadline:
         print(f"FAIL: {dropped_in_deadline} in-deadline requests dropped")

@@ -322,6 +322,94 @@ print("post-mortem OK: dead child dumped its in-flight streams before "
 print("OUT-OF-PROCESS FAILOVER OK")
 PYEOF
 
+echo "== speculative decoding: greedy digests spec-on == spec-off, accept rate + tokens/step pinned =="
+# ISSUE 17 acceptance: --spec-k 4 drafts with the self-speculative
+# n-gram proposer and scores k+1 positions in ONE verify forward.
+# Pinned: (a) greedy speculated streams digest-IDENTICAL to the spec-off
+# reference on BOTH KV layouts (bit-identity is the contract, not a
+# tolerance — and paged greedy digests equal contiguous ones, so one
+# reference covers both), (b) spec_accept_rate > 0 (tiny greedy models
+# settle into repeating cycles the drafter catches — speculation
+# actually fired), (c) effective tokens per decode step > 1.0
+# (speculation actually emitted multi-token steps, counting no-draft
+# fallback steps against it).
+rm -f /tmp/hvd_spec_off.json /tmp/hvd_spec_on.json /tmp/hvd_spec_paged.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --gen-tokens 32 \
+  --json /tmp/hvd_spec_off.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --gen-tokens 32 --spec-k 4 \
+  --json /tmp/hvd_spec_on.json
+run_cpu timeout -k 10 240 python bin/serve_bench.py --mode generate \
+  --qps 20 --duration 5 --deadline-ms 0 --gen-tokens 32 --spec-k 4 \
+  --kv-layout paged --block-size 16 --json /tmp/hvd_spec_paged.json
+python - <<'PYEOF'
+import json
+off = [json.loads(l) for l in open("/tmp/hvd_spec_off.json")][-1]
+on = [json.loads(l) for l in open("/tmp/hvd_spec_on.json")][-1]
+paged = [json.loads(l) for l in open("/tmp/hvd_spec_paged.json")][-1]
+assert off["spec_k"] == 0 and off["spec_accept_rate"] is None, off["spec_k"]
+for run, label in ((on, "contiguous"), (paged, "paged")):
+    assert run["completed"] == run["sent"] and run["failed"] == 0, \
+        (label, run["completed"], run["sent"], run["failed"])
+    assert run["spec_k"] == 4, (label, run["spec_k"])
+    assert run["stream_digest"] == off["stream_digest"], \
+        f"{label}: speculation changed a greedy token stream"
+    assert run["spec_accept_rate"] and run["spec_accept_rate"] > 0, \
+        (label, run["spec_accept_rate"])
+    assert run["tokens_per_step"] and run["tokens_per_step"] > 1.0, \
+        (label, run["tokens_per_step"])
+    print(f"{label}: digest == spec-off reference, accept_rate "
+          f"{run['spec_accept_rate']:.3f}, "
+          f"{run['tokens_per_step']:.2f} tokens/step")
+print("SPECULATIVE DECODING OK")
+PYEOF
+
+echo "== speculative decoding chaos: SIGKILL a subprocess replica mid-speculated-stream =="
+# ISSUE 17 acceptance (failover half): a speculated stream's failover
+# envelope must replay BIT-identically after a real process death. A
+# 3-member subprocess fleet speculates (--spec-k rides the child spec);
+# the chaos clause SIGKILLs r1 mid-stream. Pinned: zero lost streams,
+# >=1 resume, every client-visible stream digest IDENTICAL to the
+# spec-off single-engine reference (speculation AND cross-process
+# failover, together, changed no token), and the fleet still reports a
+# nonzero acceptance rate aggregated from the children's /stats.
+rm -f /tmp/hvd_spec_fo_ref.json /tmp/hvd_spec_fo_kill.json
+run_cpu timeout -k 10 420 python bin/serve_bench.py --mode generate \
+  --qps 60 --duration 3 --deadline-ms 0 --slots 2 --gen-tokens 32 \
+  --json /tmp/hvd_spec_fo_ref.json
+run_cpu timeout -k 10 420 python bin/serve_bench.py --mode generate \
+  --qps 60 --duration 3 --deadline-ms 0 --slots 2 --gen-tokens 32 \
+  --replicas 3 --replica-procs --spec-k 4 \
+  --chaos 'replica_proc_kill=r1@stream=3' --json /tmp/hvd_spec_fo_kill.json
+python - <<'PYEOF'
+import json
+ref = [json.loads(l) for l in open("/tmp/hvd_spec_fo_ref.json")
+       if "stream_digest" in l][-1]
+kill_rows = [json.loads(l) for l in open("/tmp/hvd_spec_fo_kill.json")]
+row = [r for r in kill_rows if "stream_digest" in r][-1]
+fleet = [r for r in kill_rows if r.get("fleet")][-1]
+assert ref["spec_k"] == 0 and row["spec_k"] == 4, \
+    (ref["spec_k"], row["spec_k"])
+assert row["completed"] == row["sent"] and row["failed"] == 0, \
+    (row["completed"], row["sent"], row["failed"])
+assert fleet["failover"]["resumed"] >= 1, fleet["failover"]
+assert fleet["failover"]["exhausted"] == 0, fleet["failover"]
+assert fleet["stranded"] >= 1, fleet
+assert fleet["drained_lost_streams"] == 0, fleet
+assert fleet["dispatch"].get("retired", 0) >= 1, fleet
+assert row["stream_digests"] == ref["stream_digests"], \
+    "speculation + process-kill failover changed a client-visible " \
+    "token stream vs the spec-off reference"
+assert fleet["spec_accept_rate"] and fleet["spec_accept_rate"] > 0, \
+    fleet["spec_accept_rate"]
+print(f"spec fleet: {fleet['stranded']} stranded -> "
+      f"{fleet['failover']['resumed']} resumed, 0 exhausted; digests "
+      f"identical to the spec-off unkilled reference; fleet accept_rate "
+      f"{fleet['spec_accept_rate']:.3f}")
+print("SPECULATIVE FAILOVER OK")
+PYEOF
+
 echo "== multi-tenant adapters: hot-evict under traffic (refusal while referenced, zero lost streams) =="
 run_cpu timeout -k 10 240 python - <<'PYEOF'
 import time

@@ -246,6 +246,79 @@ def paged_decode_step(params, last_tokens, cache: Dict, positions,
     return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
 
 
+def paged_verify_step(params, draft_tokens, cache: Dict, positions,
+                      block_tables, cfg: TransformerConfig, *,
+                      adapters=None, adapter_idx=None,
+                      lora=None) -> Tuple[Dict, Any]:
+    """Paged sibling of :func:`~.transformer.verify_step`: score
+    ``W = k + 1`` speculative positions per slot in ONE forward against
+    the block pool.
+
+    Column ``j`` writes its K/V at logical position ``positions[s] + j``
+    through the slot's block table; positions at/past the table's
+    logical capacity are redirected to the reserved TRASH_BLOCK (the
+    same write-hygiene idiom as the prefill's copy-on-write
+    redirection), so padded tail columns can never corrupt a live
+    block. Speculated writes always land in the slot's PRIVATE blocks:
+    admission reserves every position the stream may write up front,
+    and shared (copy-on-write prefix) blocks only ever cover full
+    PROMPT blocks — strictly before any generated position — so a
+    rejected draft's garbage rows need no block-ledger rollback; the
+    next step simply overwrites them before they become readable.
+
+    Returns ``(cache', logits [S, W, vocab] f32)`` with the same
+    flattened-rows bit-identity contract as the contiguous
+    ``verify_step`` (rows bitwise equal to sequential
+    ``paged_decode_step``; tests/test_spec.py pins streams across
+    layouts). Gather-fallback attention only — the Pallas decode kernel
+    is single-query and allclose- (not bitwise-) pinned, so the engine
+    refuses ``paged_kernel`` + speculation rather than mixing numerics
+    mid-stream.
+    """
+    _check_dense(cfg, "paged_verify_step")
+    S, W = draft_tokens.shape
+    from .lora import make_delta
+    aidx = (jnp.full((S,), -1, jnp.int32) if adapter_idx is None
+            else adapter_idx)
+    delta = make_delta("step", adapters, jnp.repeat(aidx, W), lora, cfg)
+    params = _gen_weights(params)
+    d_head = cfg.d_model // cfg.n_heads
+    bs = cache["k"].shape[2]
+    max_blocks = block_tables.shape[1]
+    active = positions >= 0
+    pos = jnp.where(active, positions, 0).astype(jnp.int32)
+    rows = jnp.arange(S, dtype=jnp.int32)
+    offs = jnp.arange(W, dtype=jnp.int32)   # x64 mode: indices must agree
+    wpos = pos[:, None] + offs[None, :]                      # [S, W]
+    valid = wpos < max_blocks * bs
+    bidx = jnp.minimum(wpos // bs, max_blocks - 1)
+    phys = jnp.where(valid, block_tables[rows[:, None], bidx],
+                     TRASH_BLOCK)                            # [S, W]
+    off = (wpos % bs).astype(jnp.int32)
+    flat_pos = wpos.reshape(S * W)
+    k_pool, v_pool = cache["k"], cache["v"]
+
+    def mix(li, q, k, v):
+        nonlocal k_pool, v_pool
+        k2 = k.reshape(S, W, k.shape[-2], k.shape[-1])
+        v2 = v.reshape(S, W, v.shape[-2], v.shape[-1])
+        k_pool = k_pool.at[li, phys, off].set(k2.astype(k_pool.dtype))
+        v_pool = v_pool.at[li, phys, off].set(v2.astype(v_pool.dtype))
+        kg = k_pool[li][block_tables].reshape(
+            S, max_blocks * bs, cfg.n_heads, d_head)
+        vg = v_pool[li][block_tables].reshape(
+            S, max_blocks * bs, cfg.n_heads, d_head)
+        return _cached_attention(q, jnp.repeat(kg, W, axis=0),
+                                 jnp.repeat(vg, W, axis=0), flat_pos)
+
+    logits = _step_forward(params, draft_tokens.reshape(S * W), cfg, mix,
+                           delta=delta)
+    lengths = jnp.where(active, pos + 1, cache["lengths"]
+                        ).astype(jnp.int32)
+    return ({"k": k_pool, "v": v_pool, "lengths": lengths},
+            logits.reshape(S, W, -1))
+
+
 # ---------------------------------------------------------------------------
 # Host-side block accounting: free list, refcounts, prefix registry.
 # ---------------------------------------------------------------------------

@@ -574,6 +574,84 @@ def decode_step(params, last_tokens, cache: Dict, positions,
     return {"k": k_cache, "v": v_cache, "lengths": lengths}, logits
 
 
+def verify_step(params, draft_tokens, cache: Dict, positions,
+                cfg: TransformerConfig, *, adapters=None,
+                adapter_idx=None, lora=None) -> Tuple[Dict, Any]:
+    """Speculative-decoding verify pass: score ``W = k + 1`` positions
+    per slot in ONE forward.
+
+    Args:
+      draft_tokens: [S, W] int32 — per slot, column 0 is the slot's last
+        sampled token (what ``decode_step`` would consume) and columns
+        1..k its drafted continuation; unused tail columns are padding
+        (any valid token id — their rows are never read by the host).
+      positions: [S] int32 as in :func:`decode_step` (``-1`` inactive).
+
+    Each column ``j`` writes its K/V at ``positions[s] + j`` and attends
+    the slot's cache masked to ``<= positions[s] + j`` — writes landing
+    at/past ``max_len`` are DROPPED by the scatter (out-of-bounds
+    updates), so padded tail columns near the cache edge can never
+    corrupt live rows.
+
+    Returns ``(cache', logits [S, W, vocab] f32)`` — row ``j`` is the
+    next-token distribution after consuming ``draft_tokens[s, :j + 1]``.
+
+    Bit-identity contract: the ``W`` query columns are FLATTENED onto
+    the slot axis, so every per-row matmul/norm and the cached
+    attention run at exactly the decode-step shapes over exactly the
+    per-row data sequential decode would see — logits row ``j`` and the
+    K/V bytes written at ``positions[s] + j`` are bitwise identical to
+    ``decode_step`` having consumed those tokens one at a time
+    (tests/test_spec.py pins both). The cost is attention reading a
+    ``W``-replicated cache view; a fused multi-query kernel is the
+    hardware follow-up, gated behind this same signature.
+
+    ``lengths`` bookkeeping is conservative under speculation: only
+    column 0's position is claimed (the host decides the accepted count
+    AFTER this program ran); the next step's write advances it past the
+    accepted tokens. Rows between are written-but-unclaimed, which the
+    "rows beyond lengths are garbage" contract already allows.
+    """
+    _check_dense(cfg, "verify_step")
+    S, W = draft_tokens.shape
+    from .lora import make_delta
+    # Per-(slot, column) rows flatten to [S*W]; each column inherits its
+    # slot's adapter row so the LoRA delta stays row-independent.
+    aidx = (jnp.full((S,), -1, jnp.int32) if adapter_idx is None
+            else adapter_idx)
+    delta = make_delta("step", adapters, jnp.repeat(aidx, W), lora, cfg)
+    params = _gen_weights(params)
+    active = positions >= 0
+    pos = jnp.where(active, positions, 0).astype(jnp.int32)
+    rows = jnp.arange(S, dtype=jnp.int32)
+    offs = jnp.arange(W, dtype=jnp.int32)   # x64 mode: indices must agree
+    wpos = pos[:, None] + offs[None, :]                      # [S, W]
+    flat_pos = wpos.reshape(S * W)
+    k_cache, v_cache = cache["k"], cache["v"]
+
+    def mix(li, q, k, v):
+        nonlocal k_cache, v_cache
+        k2 = k.reshape(S, W, k.shape[-2], k.shape[-1])
+        v2 = v.reshape(S, W, v.shape[-2], v.shape[-1])
+        k_cache = k_cache.at[li, rows[:, None], wpos].set(
+            k2.astype(k_cache.dtype))
+        v_cache = v_cache.at[li, rows[:, None], wpos].set(
+            v2.astype(v_cache.dtype))
+        # Each flat row (s, j) attends slot s's FULL cache row (with all
+        # W fresh writes visible) under its own mask — the same [M] view
+        # sequential decode at position pos+j reads.
+        kg = jnp.repeat(k_cache[li], W, axis=0)
+        vg = jnp.repeat(v_cache[li], W, axis=0)
+        return _cached_attention(q, kg, vg, flat_pos)
+
+    logits = _step_forward(params, draft_tokens.reshape(S * W), cfg, mix,
+                           delta=delta)
+    lengths = jnp.where(active, pos + 1, cache["lengths"]
+                        ).astype(jnp.int32)
+    return ({"k": k_cache, "v": v_cache, "lengths": lengths},
+            logits.reshape(S, W, -1))
+
+
 def make_parallel_train_step(cfg: TransformerConfig, mesh: Mesh,
                              optimizer: optax.GradientTransformation,
                              aux_weight: float = 0.01,

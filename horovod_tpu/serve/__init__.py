@@ -72,6 +72,11 @@ from .generate import (  # noqa: F401
     prefill_buckets,
 )
 from .metrics import FleetMetrics, ServeMetrics  # noqa: F401
+from .spec import (  # noqa: F401
+    DraftProposer,
+    NgramProposer,
+    SpecConfig,
+)
 from .router import FleetRouter, ReplicaHandle  # noqa: F401
 from .fleet import FleetAutoscaler, heartbeat_liveness  # noqa: F401
 from .server import HttpServer  # noqa: F401
@@ -95,12 +100,14 @@ from ..parallel.kv_blocks import (  # noqa: F401
     paged_decode_step,
     paged_kv_cache_specs,
     paged_prefill,
+    paged_verify_step,
 )
 from ..parallel.transformer import (  # noqa: F401
     decode_step,
     init_kv_cache,
     kv_cache_specs,
     prefill,
+    verify_step,
 )
 from ..exceptions import (  # noqa: F401
     DeadlineExceededError,

@@ -69,13 +69,14 @@ from ..obs import flightrec
 from ..testing import faults
 from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
                                   init_paged_kv_cache, paged_decode_step,
-                                  paged_prefill)
+                                  paged_prefill, paged_verify_step)
 from ..parallel.transformer import (TransformerConfig, decode_step,
-                                    init_kv_cache, prefill)
+                                    init_kv_cache, prefill, verify_step)
 from .adapters import AdapterRegistry
 from .batcher import RequestQueue, bucket_for
 from .engine import ReadinessMixin
 from .metrics import ServeMetrics
+from .spec import SpecConfig, accept_greedy, accept_sampled
 
 _DEFAULT = object()    # "knob not passed" sentinel (None is a real value)
 
@@ -293,6 +294,10 @@ class _GenRequest:
     # adapter's salt — with an unframed b"" it could.
     prefix_salt: bytes = b"\x00"
     _done_accounted: bool = False
+    # Speculation accounting (engine-filled when spec decoding is on):
+    # drafts proposed for / accepted into this stream.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_at is None:
@@ -313,6 +318,26 @@ class _GenRequest:
         p = e / e.sum()
         j = int(self.rng.choice(p.size, p=p))
         return int(keep[j]) if keep is not None else j
+
+    def probs(self, logits: np.ndarray) -> np.ndarray:
+        """Full-vocab probabilities under this request's temperature /
+        top-k transform — the TARGET distribution :meth:`sample` draws
+        from, as the speculative rejection rule needs it (an arbitrary
+        draft token's probability must be addressable; outside top-k it
+        is exactly 0, so off-support drafts always reject). Callers
+        guarantee ``temperature > 0``."""
+        t = self.sampling.temperature
+        x = logits.astype(np.float64) / float(t)
+        k = self.sampling.top_k
+        if k and k < x.size:
+            keep = np.argpartition(x, -k)[-k:]
+            xk = x[keep]
+            e = np.exp(xk - np.max(xk))
+            p = np.zeros(x.size, np.float64)
+            p[keep] = e / e.sum()
+            return p
+        e = np.exp(x - np.max(x))
+        return e / e.sum()
 
 
 class GenerationEngine(ReadinessMixin):
@@ -338,7 +363,8 @@ class GenerationEngine(ReadinessMixin):
 
     def __init__(self, params: Any, model_cfg: TransformerConfig,
                  config: GenerationConfig = GenerationConfig(), *,
-                 adapters: Optional[AdapterRegistry] = None):
+                 adapters: Optional[AdapterRegistry] = None,
+                 spec: Optional[SpecConfig] = None):
         if model_cfg.n_experts:
             raise NotImplementedError(
                 "generation supports dense FFNs only (n_experts=0)")
@@ -375,6 +401,25 @@ class GenerationEngine(ReadinessMixin):
         else:
             self._cache = init_kv_cache(model_cfg, s, config.max_len)
             self._blocks = None
+        # Speculative decoding plane (spec.py): draft k tokens host-side,
+        # verify k+1 positions in one compiled program, accept per slot.
+        # An optimization, never a liveness dependency — a step with no
+        # drafts anywhere is exactly the plain decode program.
+        self._spec = spec
+        if spec is not None:
+            if spec.k + 1 > config.max_len:
+                raise ValueError(
+                    f"spec k={spec.k} needs k+1 <= max_len="
+                    f"{config.max_len}")
+            if self._paged and self._use_kernel:
+                # The Pallas decode kernel is allclose- (not bitwise-)
+                # pinned against the gather path; mixing it with the
+                # gather-based verify would break the greedy
+                # spec-on == spec-off digest contract mid-stream.
+                raise ValueError(
+                    "speculative decoding requires the gather decode "
+                    "path; set paged_kernel=False")
+            self._drafter = spec.make_drafter()
         self._buckets = prefill_buckets(config.max_len)
         # Requests popped from the admission queue but not yet in a slot
         # (the paged layout can be slot-free but block-starved; FIFO is
@@ -501,6 +546,31 @@ class GenerationEngine(ReadinessMixin):
                            + ([i32(s)] if has_ad else [])
                            + ([i32(s, nb)] if paged else []))
                     exe = jax.jit(_decode).lower(*sds).compile()
+                elif isinstance(key, tuple) and key[0] == "verify":
+                    w = key[1]    # k + 1 positions per slot
+
+                    def _verify(*a):
+                        it = iter(a)
+                        p = next(it)
+                        at = next(it) if has_ad else None
+                        toks, c, pos = next(it), next(it), next(it)
+                        aidx = next(it) if has_ad else None
+                        if paged:
+                            return paged_verify_step(
+                                p, toks, c, pos, next(it), cfg,
+                                adapters=at, adapter_idx=aidx, lora=lcfg)
+                        return verify_step(p, toks, c, pos, cfg,
+                                           adapters=at, adapter_idx=aidx,
+                                           lora=lcfg)
+                    # Same signature rule as "decode" — only the token
+                    # operand widens to [S, W]. Exactly ONE verify
+                    # executable per engine (one k), the compile-cache
+                    # pin tests/test_spec.py holds.
+                    sds = ([p_sds] + ([a_sds] if has_ad else [])
+                           + [i32(s, w), c_sds, i32(s)]
+                           + ([i32(s)] if has_ad else [])
+                           + ([i32(s, nb)] if paged else []))
+                    exe = jax.jit(_verify).lower(*sds).compile()
                 else:
                     t = key[1]
 
@@ -531,7 +601,7 @@ class GenerationEngine(ReadinessMixin):
                 self._compiled[key] = exe
                 with self._stats_lock:
                     self._compiled_ids.add(
-                        key if key == "decode" else f"prefill_{key[1]}")
+                        key if key == "decode" else f"{key[0]}_{key[1]}")
         return exe
 
     def warmup(self) -> Tuple[Any, ...]:
@@ -556,6 +626,21 @@ class GenerationEngine(ReadinessMixin):
             args.append(np.full((s, nb), TRASH_BLOCK, np.int32))
         out = self._compile("decode")(*args)
         jax.block_until_ready(out)
+        spec_keys: Tuple[Any, ...] = ()
+        if self._spec is not None:
+            w = self._spec.k + 1
+            args = [self._params]
+            if has_ad:
+                args.append(self._adapters.table())
+            args += [np.zeros((s, w), np.int32), self._cache,
+                     np.full((s,), -1, np.int32)]
+            if has_ad:
+                args.append(np.full((s,), -1, np.int32))
+            if self._paged:
+                args.append(np.full((s, nb), TRASH_BLOCK, np.int32))
+            out = self._compile(("verify", w))(*args)
+            jax.block_until_ready(out)
+            spec_keys = (("verify", w),)
         for t in self._buckets:
             args = [self._params]
             if has_ad:
@@ -569,7 +654,7 @@ class GenerationEngine(ReadinessMixin):
             out = self._compile(("prefill", t))(*args)
             jax.block_until_ready(out)
         self._warmed = True
-        return ("decode",) + tuple(self._buckets)
+        return ("decode",) + spec_keys + tuple(self._buckets)
 
     # -- client API --------------------------------------------------------
 
@@ -793,6 +878,7 @@ class GenerationEngine(ReadinessMixin):
         if self._adapters is not None:
             snap["adapters_resident"] = len(self._adapters.resident())
             snap["adapter_table"] = self._adapters.gauges()
+        snap["spec_k"] = self._spec.k if self._spec is not None else 0
         with self._stats_lock:
             snap["compiled"] = sorted(map(str, self._compiled_ids))
         snap["max_queue"] = self._cfg.max_queue
@@ -960,7 +1046,7 @@ class GenerationEngine(ReadinessMixin):
                     if outcome == "ok":
                         free.pop(0)
                 if any(r is not None for r in self._slots):
-                    self._decode_once()
+                    self._step_once()
                 elif self._held:
                     # Starved with nothing in flight: the submit-time
                     # pool-size check makes this unreachable (every block
@@ -1119,6 +1205,121 @@ class GenerationEngine(ReadinessMixin):
             self._tables[slot] = read_row
         return "ok"
 
+    def _step_once(self) -> None:
+        """One decode-step boundary: the speculative draft→verify→accept
+        step when speculation is configured, the plain one-token decode
+        otherwise."""
+        if self._spec is None:
+            self._decode_once()
+        else:
+            self._spec_once()
+
+    def _spec_once(self) -> None:
+        """Draft k tokens per slot host-side, verify all k+1 positions in
+        ONE compiled forward, accept per slot.
+
+        Acceptance is per-slot VARIABLE: a slot whose drafts all miss
+        still emits one token (verify row 0 is bitwise the decode-step
+        logits), and a step where NO slot drafted anything falls through
+        to the plain decode program — speculation is an optimization,
+        never a liveness dependency. Greedy acceptance emits exactly the
+        one-token stream (digest-pinned in ci.sh); sampled acceptance is
+        the seeded rejection rule in :mod:`.spec`. Every accepted token
+        flows through ``handle._emit`` one at a time, so fleet failover
+        envelopes replay a speculated stream token-for-token unchanged.
+        """
+        k = self._spec.k
+        w = k + 1
+        t0 = time.monotonic()
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        # Pad columns repeat the slot's last token: always a valid id,
+        # and the rows are never read by the host (their K/V writes are
+        # overwritten before the mask ever exposes them).
+        toks = np.repeat(self._last.copy()[:, None], w, axis=1)
+        drafts: Dict[int, np.ndarray] = {}
+        for i in active:
+            req = self._slots[i]
+            # Most tokens this stream may still emit (budget + cache
+            # room); drafting past cap-1 can't be accepted AND keeps
+            # every write inside the blocks admission reserved.
+            cap = min(req.max_new - req.n_out,
+                      self._cfg.max_len - int(self._positions[i]))
+            if cap < 2:
+                continue
+            ctx = np.concatenate(
+                [np.asarray(req.tokens, np.int64),
+                 np.asarray(req.handle._tokens, np.int64)])
+            d = np.asarray(self._drafter.propose(ctx, min(k, cap - 1)),
+                           np.int64).ravel()[:min(k, cap - 1)]
+            d = d[(d >= 0) & (d < self._model_cfg.vocab)]
+            if d.size:
+                drafts[i] = d
+                toks[i, 1:1 + d.size] = d
+        draft_ms = (time.monotonic() - t0) * 1e3
+        if not drafts:
+            # Plain one-token step (still counted: tokens-per-step is an
+            # EFFECTIVE rate over every step speculation supervised).
+            self._decode_once()
+            self._metrics.on_spec_step(0, 0, len(active), draft_ms, 0.0)
+            return
+        t1 = time.monotonic()
+        args = [self._params]
+        if self._adapters is not None:
+            args.append(self._adapters.table())
+        args += [toks, self._cache, self._positions.copy()]
+        if self._adapters is not None:
+            args.append(self._adapter_idx.copy())
+        if self._paged:
+            args.append(self._tables.copy())
+        cache, logits = self._compile(("verify", w))(*args)
+        logits_np = np.asarray(logits)          # [S, W, vocab], blocks
+        self._cache = cache
+        exec_ms = (time.monotonic() - t1) * 1e3
+        self._peak_active = max(self._peak_active, len(active))
+        self._metrics.on_batch(self._cfg.max_slots, len(active), exec_ms,
+                               len(self._queue))
+        proposed = accepted = emitted_total = 0
+        for i in active:
+            req = self._slots[i]
+            rows = logits_np[i]
+            d = drafts.get(i)
+            if d is None:
+                cand, hits = [req.sample(rows[0])], 0
+            elif req.sampling.temperature <= 0:
+                cand, hits = accept_greedy(rows, d)
+            else:
+                cand, hits = accept_sampled(rows, d, req.probs, req.rng)
+            emitted = 0
+            reason = None
+            for tok in cand:
+                tok = int(tok)
+                req.n_out += 1
+                self._metrics.on_tokens(tenant=self._tenant_label(req))
+                req.handle._emit(tok)
+                self._positions[i] += 1
+                self._last[i] = tok
+                emitted += 1
+                reason = self._finish_reason(
+                    req, tok, next_pos=int(self._positions[i]))
+                if reason:
+                    break
+            n_prop = int(d.size) if d is not None else 0
+            # EOS/length can truncate mid-acceptance; only tokens that
+            # actually reached the stream count as accepted drafts.
+            n_hit = min(hits, emitted)
+            req.spec_proposed += n_prop
+            req.spec_accepted += n_hit
+            proposed += n_prop
+            accepted += n_hit
+            emitted_total += emitted
+            if reason:
+                # Counters first: _finish stamps the per-request spec
+                # accounting into the result info.
+                self._finish(req, reason)
+                self._release_slot(i)
+        self._metrics.on_spec_step(proposed, accepted, emitted_total,
+                                   draft_ms, exec_ms)
+
     def _decode_once(self) -> None:
         t0 = time.monotonic()
         args = [self._params]
@@ -1182,4 +1383,9 @@ class GenerationEngine(ReadinessMixin):
             "adapter": req.adapter,
             "tokens_per_sec": ((req.n_out - 1) / gen_s
                                if req.n_out > 1 and gen_s > 0 else None),
+            # Per-request speculation accounting (None = spec off).
+            "spec_accept_rate": (
+                (req.spec_accepted / req.spec_proposed
+                 if req.spec_proposed else 0.0)
+                if self._spec is not None else None),
         })

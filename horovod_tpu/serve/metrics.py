@@ -156,6 +156,22 @@ class ServeMetrics:
         self.prefix_misses_total = 0
         self.prefix_hit_blocks_total = 0
         self.prefix_lookup_blocks_total = 0
+        # Speculative-decoding plane: one "spec step" is one decode-step
+        # boundary supervised by speculation (a verify forward, or the
+        # plain one-token fallback when no slot drafted). Zero for
+        # engines without a SpecConfig.
+        self.spec_steps_total = 0
+        self.spec_draft_tokens_total = 0      # proposed by the drafter
+        self.spec_accepted_tokens_total = 0   # proposals that reached streams
+        self.spec_emitted_tokens_total = 0    # all tokens out of spec steps
+        self._spec_draft_ms = _Reservoir(seed=5)
+        self._spec_verify_ms = _Reservoir(seed=6)
+        self._h_spec_draft = self.registry.histogram(
+            "hvd_spec_draft_seconds",
+            "Host-side draft proposal time per spec step")
+        self._h_spec_verify = self.registry.histogram(
+            "hvd_spec_verify_seconds",
+            "Verify-forward execution time per spec step")
         # Per-tenant recorders (multi-tenant adapters): lazily created on
         # first tenant-stamped event. Engines without an AdapterRegistry
         # never stamp one (GenerationEngine._tenant_label), so base-only
@@ -242,6 +258,25 @@ class ServeMetrics:
                 self._tenant(tenant)["tokens_generated_total"] += n
         if tenant is not None:
             self._c_tenant_tokens.labels(tenant=tenant).inc(n)
+
+    def on_spec_step(self, proposed: int, accepted: int, emitted: int,
+                     draft_ms: float, verify_ms: float) -> None:
+        """One speculation-supervised decode step: ``proposed`` draft
+        tokens across the batch, ``accepted`` of them emitted, plus the
+        non-draft tokens, ``emitted`` in total. ``verify_ms`` is 0 for
+        a no-draft step that fell through to the plain decode (its
+        execute time lands in the batch histogram either way)."""
+        with self._lock:
+            self.spec_steps_total += 1
+            self.spec_draft_tokens_total += proposed
+            self.spec_accepted_tokens_total += accepted
+            self.spec_emitted_tokens_total += emitted
+            self._spec_draft_ms.add(draft_ms)
+            if verify_ms > 0:
+                self._spec_verify_ms.add(verify_ms)
+        self._h_spec_draft.observe(draft_ms / 1e3)
+        if verify_ms > 0:
+            self._h_spec_verify.observe(verify_ms / 1e3)
 
     def on_prefix(self, hit_blocks: int, prompt_blocks: int) -> None:
         """One prefix-cache lookup at admission: ``hit_blocks`` of the
@@ -385,6 +420,29 @@ class ServeMetrics:
                     "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
                     "tokens_per_sec_user_p99": self._tps_user.quantile(0.99),
                 },
+                # Speculation effectiveness: acceptance rate over
+                # proposed drafts and the EFFECTIVE tokens-per-step
+                # (>1.0 means speculation is beating one-token decode).
+                "spec": {
+                    "steps_total": self.spec_steps_total,
+                    "draft_tokens_total": self.spec_draft_tokens_total,
+                    "accepted_tokens_total":
+                        self.spec_accepted_tokens_total,
+                    "emitted_tokens_total":
+                        self.spec_emitted_tokens_total,
+                    "accept_rate": (
+                        self.spec_accepted_tokens_total
+                        / self.spec_draft_tokens_total
+                        if self.spec_draft_tokens_total else None),
+                    "tokens_per_step": (
+                        self.spec_emitted_tokens_total
+                        / self.spec_steps_total
+                        if self.spec_steps_total else None),
+                    "draft_ms_p50": self._spec_draft_ms.quantile(0.50),
+                    "draft_ms_p99": self._spec_draft_ms.quantile(0.99),
+                    "verify_ms_p50": self._spec_verify_ms.quantile(0.50),
+                    "verify_ms_p99": self._spec_verify_ms.quantile(0.99),
+                },
                 # Per-tenant split (multi-tenant adapters): the latency
                 # numbers a per-tenant SLO is written against. Empty dict
                 # until a tenant-stamped request finishes.
@@ -450,6 +508,8 @@ _TOP = {
                    "Tokens per KV block (paged layout)"),
     "adapters_resident": ("hvd_adapters_resident", "gauge",
                           "LoRA adapters resident in the device table"),
+    "spec_k": ("hvd_spec_k", "gauge",
+               "Max draft tokens per decode step (0 = speculation off)"),
 }
 
 _GENERATION = {
@@ -467,6 +527,22 @@ _GENERATION = {
     "prefix_lookup_blocks_total": ("hvd_prefix_lookup_blocks_total",
                                    "counter",
                                    "Prompt blocks looked up"),
+}
+
+_SPEC = {
+    "steps_total": ("hvd_spec_steps_total", "counter",
+                    "Decode steps supervised by speculation"),
+    "draft_tokens_total": ("hvd_spec_draft_tokens_total", "counter",
+                           "Draft tokens proposed"),
+    "accepted_tokens_total": ("hvd_spec_accepted_tokens_total", "counter",
+                              "Draft tokens accepted into streams"),
+    "emitted_tokens_total": ("hvd_spec_emitted_tokens_total", "counter",
+                             "Tokens emitted by speculation-supervised "
+                             "steps"),
+    "accept_rate": ("hvd_spec_accept_rate", "gauge",
+                    "Accepted / proposed draft tokens (cumulative)"),
+    "tokens_per_step": ("hvd_spec_tokens_per_step", "gauge",
+                        "Effective tokens per decode step (cumulative)"),
 }
 
 _BLOCKS = {
@@ -702,6 +778,7 @@ def collect_stats(snap: Dict, registry: MetricsRegistry,
 
     _emit(_TOP, snap)
     _emit(_GENERATION, snap.get("generation") or {})
+    _emit(_SPEC, snap.get("spec") or {})
     _emit(_BLOCKS, snap.get("blocks") or {})
     meta["hvd_rejected_total"] = (
         "counter", "Door rejections split by the scarce resource")

@@ -237,6 +237,25 @@ class ProcReplicaClient:
         self._last_stats = snap
         return snap
 
+    def adapter_names(self) -> Optional[Tuple[str, ...]]:
+        """Resident adapter names from the child's ``/stats``
+        ``adapter_table`` block — the surface the router's
+        adapter-affinity dispatch reads (``None`` = the child hosts no
+        registry and can never take adapter traffic). Served from the
+        stats cache (``load()`` refreshes it every dispatch walk); one
+        fresh fetch when nothing is cached yet."""
+        snap = self._last_stats
+        if not snap:
+            snap = self.stats()
+        table = snap.get("adapter_table")
+        if not isinstance(table, dict):
+            return None
+        return tuple(table.get("names") or ())
+
+    def adapters_resident(self) -> Optional[int]:
+        names = self.adapter_names()
+        return None if names is None else len(names)
+
     def _active_rows(self) -> int:
         """Best-effort active-slot count for the router's fleet peak
         sampling — read from the stats cache (a fresh HTTP fetch per
@@ -538,7 +557,11 @@ def spawn_replica_factory(spec: Dict[str, Any], *,
     from (see :func:`worker_main`): ``model`` (TransformerConfig kwargs,
     dtypes as strings), ``seed`` (param init — same seed + dims ⇒
     bit-identical weights in every child), ``generation``
-    (GenerationConfig kwargs), optional ``warmup`` (default True).
+    (GenerationConfig kwargs), optional ``warmup`` (default True),
+    optional ``spec`` (speculative decoding — SpecConfig kwargs) and
+    ``adapters`` (seeded LoRA tenants + quotas; see
+    :func:`_build_adapters` — trees are re-derived from seeds in the
+    child, never shipped as bytes).
     Each spawned child inherits the parent environment — fault specs
     (``HVD_FAULT_SPEC``) reach the child loop — and gets a PER-REPLICA
     flight-recorder dump dir (``$HVD_FLIGHTREC_DIR/<name>``) so two
@@ -604,6 +627,42 @@ def _resolve_dtype(jnp, name):
     return table[name]
 
 
+def _build_adapters(mcfg, ad: Optional[Dict[str, Any]]):
+    """The worker's adapter plane from the spec's JSON ``"adapters"``
+    block: ``{"rank", "alpha", "capacity", "entries": [{"name", "seed",
+    "b_scale", "quota"}, ...]}``. Trees are re-derived from per-entry
+    seeds (``init_adapter(PRNGKey(seed), ...)``), not shipped as bytes —
+    the same trick the base params use, so a replacement child after a
+    SIGKILL holds bit-identical tables and per-tenant failover replay
+    stays digest-exact. ``quota`` (optional, per entry) caps that
+    tenant's in-flight streams; a ``"base_quota"`` key quotas the
+    no-adapter tenant."""
+    if not ad:
+        return None
+    import jax
+
+    from ..parallel.lora import LoraConfig, init_adapter
+    from .adapters import AdapterRegistry
+
+    entries = list(ad.get("entries") or [])
+    if not entries:
+        return None
+    lora = LoraConfig(rank=int(ad.get("rank", 4)),
+                      alpha=float(ad.get("alpha", 8.0)))
+    reg = AdapterRegistry(mcfg, lora,
+                          capacity=int(ad.get("capacity", len(entries))))
+    for e in sorted(entries, key=lambda x: str(x.get("name"))):
+        tree = init_adapter(jax.random.PRNGKey(int(e["seed"])), mcfg,
+                            lora, b_scale=float(e.get("b_scale", 0.0)))
+        q = e.get("quota")
+        reg.load(str(e["name"]), tree,
+                 quota=int(q) if q is not None else None)
+    bq = ad.get("base_quota")
+    if bq is not None:
+        reg.set_quota("base", int(bq))
+    return reg
+
+
 def worker_main(argv: Optional[List[str]] = None) -> int:
     """The replica worker: spec → engine → warmup → HttpServer → ready
     file, then block on the stdin control channel until the parent says
@@ -643,6 +702,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     from ..parallel.transformer import TransformerConfig, init_params
     from .generate import GenerationConfig, GenerationEngine
     from .server import HttpServer
+    from .spec import SpecConfig
 
     model_kw = dict(spec.get("model") or {})
     for key in ("dtype", "unembed_dtype"):
@@ -651,7 +711,17 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     mcfg = TransformerConfig(**model_kw)
     params = init_params(jax.random.PRNGKey(int(spec.get("seed", 0))), mcfg)
     gcfg = GenerationConfig(**(spec.get("generation") or {}))
-    eng = GenerationEngine(params, mcfg, gcfg)
+    # Optional planes, both JSON-derived so every sibling child is
+    # bit-identical: "spec" → speculative decoding (SpecConfig kwargs),
+    # "adapters" → LoRA tenants re-derived from per-entry seeds (same
+    # seed + dims ⇒ the same adapter bytes in every child, exactly like
+    # the base params — so per-tenant stream digests stay comparable
+    # across thread and subprocess topologies).
+    spec_cfg = (SpecConfig.from_spec(spec["spec"])
+                if spec.get("spec") else None)
+    registry = _build_adapters(mcfg, spec.get("adapters"))
+    eng = GenerationEngine(params, mcfg, gcfg, adapters=registry,
+                           spec=spec_cfg)
     eng.serve_name = name       # fault clauses + flightrec key on it
     engine_ref.append(eng)
     if spec.get("warmup", True):

@@ -313,6 +313,7 @@ class FleetRouter:
         # for the dispatch counter.
         self._retired_totals: Dict[str, float] = {}
         self._retired_gen_totals: Dict[str, float] = {}
+        self._retired_spec_totals: Dict[str, float] = {}
         self._retired_tenant_totals: Dict[str, Dict[str, float]] = {}
         # Fleet-wide concurrency high-water, sampled at dispatch and
         # stats boundaries. Summing per-replica peaks would add maxima
@@ -560,6 +561,11 @@ class FleetRouter:
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     self._retired_gen_totals[key] = (
                         self._retired_gen_totals.get(key, 0) + v)
+            for key in self._SPEC_SUM_KEYS:
+                v = (snap.get("spec") or {}).get(key)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    self._retired_spec_totals[key] = (
+                        self._retired_spec_totals.get(key, 0) + v)
             for tenant, tv in (snap.get("tenants") or {}).items():
                 base = self._retired_tenant_totals.setdefault(tenant, {})
                 for key in self._TENANT_SUM_KEYS:
@@ -1253,6 +1259,14 @@ class FleetRouter:
     # and stay in the nested per-replica snapshots (scrape the
     # hvd_tenant_* histograms for fleet-wide tenant quantiles).
     _TENANT_SUM_KEYS = ("generations_total", "tokens_generated_total")
+    # Speculative-decoding counters summed across replicas (+ retired
+    # baselines). The derived ratios (accept_rate, tokens_per_step) are
+    # recomputed fleet-wide from the summed counters — averaging
+    # per-replica ratios would weight idle replicas equally with busy
+    # ones. Timing percentiles stay per-replica (scrape the
+    # hvd_spec_*_seconds histograms for fleet quantiles).
+    _SPEC_SUM_KEYS = ("steps_total", "draft_tokens_total",
+                      "accepted_tokens_total", "emitted_tokens_total")
 
     def stats(self) -> Dict:
         """The fleet ``/stats`` snapshot: aggregate counters at the top
@@ -1277,6 +1291,7 @@ class FleetRouter:
         with self._lock:
             retired = dict(self._retired_totals)
             retired_gen = dict(self._retired_gen_totals)
+            retired_spec = dict(self._retired_spec_totals)
             retired_tenants = {t: dict(v) for t, v in
                                self._retired_tenant_totals.items()}
         for key in self._SUM_KEYS:
@@ -1311,6 +1326,30 @@ class FleetRouter:
             "prefix_misses_total", 0)
         snap["prefix_hit_rate"] = (hits / (hits + misses)
                                    if hits + misses else None)
+        # Speculative-decoding fleet aggregate: engines always emit a
+        # "spec" block (zeros when speculation is off), so this mirrors
+        # the single-engine shape; absent only for an empty fleet with
+        # no retired history.
+        spec_snaps = [p.get("spec") for p in per.values()
+                      if isinstance(p.get("spec"), dict)]
+        if spec_snaps or retired_spec:
+            spec: Dict[str, Any] = {}
+            for key in self._SPEC_SUM_KEYS:
+                vals = [s.get(key) for s in spec_snaps
+                        if isinstance(s.get(key), (int, float))]
+                spec[key] = sum(vals) + retired_spec.get(key, 0)
+            prop = spec.get("draft_tokens_total", 0)
+            spec["accept_rate"] = (
+                spec.get("accepted_tokens_total", 0) / prop
+                if prop else None)
+            steps = spec.get("steps_total", 0)
+            spec["tokens_per_step"] = (
+                spec.get("emitted_tokens_total", 0) / steps
+                if steps else None)
+            snap["spec"] = spec
+            ks = [p.get("spec_k") for p in per.values()
+                  if isinstance(p.get("spec_k"), int)]
+            snap["spec_k"] = max(ks) if ks else 0
         # Per-tenant counter aggregates (multi-tenant adapters): summed
         # across live replicas plus retired baselines, keyed exactly as
         # one engine's snapshot keys them.

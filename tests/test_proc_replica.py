@@ -458,3 +458,85 @@ class TestPollerWalksChildren:
         finally:
             child.shutdown()
             child.server_close()
+
+
+class TestAdapterPlane:
+    """PR-16 leftover closed by ISSUE 17: the subprocess spec carries
+    the adapter plane as seeds + quotas, and the client advertises the
+    child's resident names so the router's adapter-affinity dispatch
+    treats process replicas exactly like thread replicas."""
+
+    def test_build_adapters_absent_block_is_none(self):
+        from horovod_tpu.serve.proc_replica import _build_adapters
+        assert _build_adapters(object(), None) is None
+        assert _build_adapters(object(), {}) is None
+        assert _build_adapters(object(), {"entries": []}) is None
+
+    def test_build_adapters_rederives_trees_and_quotas(self):
+        """Trees come from seeds, not bytes: the registry a child builds
+        must hold rows BIT-identical to ``init_adapter(PRNGKey(seed))``
+        — that is the whole cross-process digest-replay argument."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from horovod_tpu.parallel.lora import LoraConfig, init_adapter
+        from horovod_tpu.parallel.transformer import TransformerConfig
+        from horovod_tpu.serve.proc_replica import _build_adapters
+
+        cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                                n_layers=2, d_ff=32, dtype=jnp.float32,
+                                unembed_dtype=jnp.float32,
+                                attn_backend="xla")
+        reg = _build_adapters(cfg, {
+            "rank": 2, "alpha": 8.0, "capacity": 3,
+            "entries": [
+                {"name": "a1", "seed": 101, "b_scale": 0.5, "quota": 2},
+                {"name": "a0", "seed": 100, "b_scale": 0.5},
+            ],
+            "base_quota": 7,
+        })
+        assert reg.resident() == ("a0", "a1")
+        assert reg.capacity == 3
+        assert reg.quota("a1") == 2
+        assert reg.quota("a0") is None
+        assert reg.quota("base") == 7
+
+        ref = init_adapter(jax.random.PRNGKey(101), cfg,
+                           LoraConfig(rank=2, alpha=8.0), b_scale=0.5)
+        row = reg.index_of("a1")
+        for got, want in zip(jax.tree_util.tree_leaves(reg.table()),
+                             jax.tree_util.tree_leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(got[row]),
+                                          np.asarray(want))
+
+    def test_adapter_names_reads_child_stats_table(self, scripted):
+        port, set_script = scripted
+        set_script(lambda h, path, body=None: h.reply_json(200, {
+            "adapter_table": {"names": ["a0", "a1"], "capacity": 2},
+            "active_slots": 0}))
+        c = _client(port)
+        assert c.adapter_names() == ("a0", "a1")
+        assert c.adapters_resident() == 2
+
+    def test_adapter_names_none_without_registry(self, scripted):
+        """No ``adapter_table`` block = the child hosts no registry:
+        None tells the router this replica can never take adapter
+        traffic (distinct from an empty-but-present table)."""
+        port, set_script = scripted
+        set_script(lambda h, path, body=None: h.reply_json(200, {
+            "active_slots": 0}))
+        c = _client(port)
+        assert c.adapter_names() is None
+        assert c.adapters_resident() is None
+
+    def test_adapter_names_served_from_stats_cache(self, scripted):
+        """Dispatch reads names every walk — they must come from the
+        cached snapshot, not a fresh HTTP round-trip per dispatch."""
+        port, set_script = scripted
+        set_script(lambda h, path, body=None: h.reply_json(200, {
+            "adapter_table": {"names": ["a0"]}}))
+        c = _client(port)
+        c.stats()
+        set_script(lambda h, path, body=None: h.reply_json(500, {}))
+        assert c.adapter_names() == ("a0",)

@@ -1,0 +1,442 @@
+"""Speculative decoding (ISSUE 17): drafter units, acceptance rules,
+verify-forward bitwise parity against sequential decode, engine-level
+greedy digest identity across layouts, and the compile-cache pin.
+
+All CPU and deliberately tiny (the tier-1 budget is nearly full): one
+module-scoped model shared by every engine, engines built lazily per
+layout and shut down once at module teardown, NO engine warmup (lazy
+compiles cover exactly the buckets the prompts touch). The open-loop
+spec benches and the subprocess-failover replay drill live in ci.sh.
+
+The load-bearing claim everything here leans on: ``verify_step`` folds
+the W = k+1 query columns onto the slot axis and runs the SAME compiled
+dense/attention ops as ``decode_step``, so its logits and cache writes
+are BITWISE equal to W sequential decode steps (jit vs jit) — not
+allclose-equal. That is what lets the engine mix verify and plain
+decode programs mid-stream without perturbing a greedy digest.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import serve
+from horovod_tpu.parallel.kv_blocks import (TRASH_BLOCK,
+                                            init_paged_kv_cache,
+                                            paged_decode_step,
+                                            paged_prefill,
+                                            paged_verify_step)
+from horovod_tpu.parallel.lora import LoraConfig, init_adapter
+from horovod_tpu.parallel.transformer import (TransformerConfig,
+                                              decode_step, init_kv_cache,
+                                              init_params, prefill,
+                                              verify_step)
+from horovod_tpu.serve.spec import (NgramProposer, SpecConfig,
+                                    accept_greedy, accept_sampled)
+
+CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+# -- drafter ----------------------------------------------------------------
+
+
+class TestNgramProposer:
+    def test_repeated_ngram_proposes_continuation(self):
+        p = NgramProposer()
+        ctx = np.array([5, 6, 7, 9, 5, 6, 7])
+        # Suffix 3-gram [5,6,7] occurred at 0; what followed was 9,5,6.
+        np.testing.assert_array_equal(p.propose(ctx, 3), [9, 5, 6])
+
+    def test_most_recent_occurrence_wins(self):
+        p = NgramProposer()
+        # Suffix [1,2] occurs at 0 (followed by 9) and at 3 (followed
+        # by 8): recent repetition predicts — 8 must lead.
+        ctx = np.array([1, 2, 9, 1, 2, 8, 1, 2])
+        assert p.propose(ctx, 1).tolist() == [8]
+
+    def test_no_match_and_short_context_are_empty(self):
+        p = NgramProposer()
+        assert p.propose(np.array([1, 2, 3, 4]), 3).size == 0
+        assert p.propose(np.array([7]), 3).size == 0
+        assert p.propose(np.array([1, 2, 1, 2]), 0).size == 0
+
+    def test_proposal_truncates_to_k_and_to_context_end(self):
+        p = NgramProposer()
+        ctx = np.array([3, 4, 5, 6, 3, 4])
+        # Match at 0, continuation [5, 6, 3, 4] capped at k.
+        assert p.propose(ctx, 2).tolist() == [5, 6]
+        # ...and never reads past the end of the context.
+        assert p.propose(ctx, 99).tolist() == [5, 6, 3, 4]
+
+    def test_min_ngram_gates_single_token_matches(self):
+        ctx = np.array([9, 3, 1, 2, 3])
+        assert NgramProposer().propose(ctx, 2).tolist() == [1, 2]
+        assert NgramProposer(min_ngram=2).propose(ctx, 2).size == 0
+
+    def test_window_validation(self):
+        with pytest.raises(ValueError):
+            NgramProposer(max_ngram=2, min_ngram=3)
+
+
+class TestSpecConfig:
+    def test_roundtrip(self):
+        c = SpecConfig(k=6, max_ngram=4, min_ngram=2)
+        assert SpecConfig.from_spec(c.to_spec()) == c
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SpecConfig(k=0)
+        with pytest.raises(ValueError):
+            SpecConfig(min_ngram=5, max_ngram=2)
+
+    def test_custom_drafter_not_serialisable(self):
+        class D:
+            def propose(self, context, k):
+                return np.empty((0,), np.int64)
+
+        c = SpecConfig(k=2, drafter=D())
+        assert c.make_drafter() is c.drafter
+        with pytest.raises(ValueError):
+            c.to_spec()
+
+
+# -- acceptance rules -------------------------------------------------------
+
+
+def _onehot_rows(tokens, vocab=8):
+    rows = np.full((len(tokens), vocab), -10.0)
+    for j, t in enumerate(tokens):
+        rows[j, t] = 10.0
+    return rows
+
+
+class TestAcceptance:
+    def test_greedy_full_accept_emits_bonus(self):
+        rows = _onehot_rows([3, 4, 5, 6])
+        toks, hits = accept_greedy(rows, [3, 4, 5])
+        assert toks == [3, 4, 5, 6] and hits == 3
+
+    def test_greedy_mismatch_stops_at_correction(self):
+        rows = _onehot_rows([3, 7, 5, 6])
+        toks, hits = accept_greedy(rows, [3, 4, 5])
+        # Row 1's argmax corrects the draft; later rows sit on a false
+        # context and must never be read.
+        assert toks == [3, 7] and hits == 1
+
+    def test_sampled_preserves_target_distribution(self):
+        """The rejection rule's whole point: the marginal over the first
+        emitted token equals the target distribution EXACTLY, however
+        bad the draft. Chi-square over a deterministic seeded run; the
+        0.999 critical value for df=7 is 24.32."""
+        vocab = 8
+        p = np.arange(1.0, vocab + 1.0)
+        p /= p.sum()
+        logits = np.log(p)
+        rows = np.stack([logits, logits])      # 1 draft + bonus row
+        rng = np.random.default_rng(0)
+        draft_token = 2                        # p[2] ~ 0.083: mostly rejected
+        n = 4000
+        counts = np.zeros(vocab)
+        for _ in range(n):
+            toks, _ = accept_sampled(rows, [draft_token],
+                                     lambda r: np.exp(r) / np.exp(r).sum(),
+                                     rng)
+            counts[toks[0]] += 1
+        chi2 = float((((counts - n * p) ** 2) / (n * p)).sum())
+        assert chi2 < 24.32, (chi2, counts / n, p)
+
+    def test_sampled_is_a_pure_function_of_the_rng(self):
+        rows = np.random.RandomState(3).randn(4, 8)
+        probs = lambda r: (lambda e: e / e.sum())(np.exp(r - r.max()))
+        a = accept_sampled(rows, [1, 2, 3], probs,
+                           np.random.default_rng(42))
+        b = accept_sampled(rows, [1, 2, 3], probs,
+                           np.random.default_rng(42))
+        assert a == b
+
+    def test_sampled_point_mass_edge_accepts_draft(self):
+        # Target distribution IS the point mass on the draft token: the
+        # residual is empty and the only lawful emission is the draft.
+        p = np.zeros(8)
+        p[5] = 1.0
+        rows = np.stack([np.log(np.maximum(p, 1e-300))] * 2)
+        toks, hits = accept_sampled(rows, [5], lambda r: p,
+                                    np.random.default_rng(0))
+        assert toks[0] == 5 and hits >= 1
+
+
+# -- verify forward: bitwise parity with sequential decode ------------------
+
+
+def _greedy_chain(params, cfg, dec, cache0, last0, pos0, w):
+    """W sequential jit'd decode steps from (cache0, last0, pos0):
+    returns (tokens consumed, per-step logits, final cache)."""
+    cache, last, pos = cache0, last0.copy(), pos0.copy()
+    toks, logs = [last.copy()], []
+    for _ in range(w):
+        cache, lg = dec(params, last, cache, pos)
+        lg = np.asarray(lg)
+        logs.append(lg)
+        last = lg.argmax(-1).astype(np.int32)
+        toks.append(last.copy())
+        pos = pos + (pos >= 0)
+    return np.stack(toks[:w], axis=1), np.stack(logs, axis=1), cache
+
+
+class TestVerifyBitwiseParity:
+    def test_contiguous_verify_matches_sequential(self, model):
+        cfg, params = model
+        S, L, W = 2, 5, 4
+        cache = init_kv_cache(cfg, S, 32)
+        pre = jax.jit(lambda p, t, c, s: prefill(p, t, c, s, cfg, length=L))
+        rng = np.random.RandomState(1)
+        plog = []
+        for s in range(S):
+            toks = rng.randint(0, cfg.vocab, (8,)).astype(np.int32)
+            cache, lg = pre(params, toks, cache, s)
+            plog.append(np.asarray(lg)[L - 1])
+        last = np.stack(plog).argmax(-1).astype(np.int32)
+        pos = np.full((S,), L, np.int32)
+
+        dec = jax.jit(lambda p, t, c, q: decode_step(p, t, c, q, cfg))
+        drafts, ref_logits, ref_cache = _greedy_chain(
+            params, cfg, dec, cache, last, pos, W)
+
+        ver = jax.jit(lambda p, t, c, q: verify_step(p, t, c, q, cfg))
+        vcache, vlog = ver(params, drafts, cache, pos)
+        # Bitwise, not allclose: the digest contract rests on it.
+        np.testing.assert_array_equal(np.asarray(vlog), ref_logits)
+        np.testing.assert_array_equal(np.asarray(vcache["k"]),
+                                      np.asarray(ref_cache["k"]))
+        np.testing.assert_array_equal(np.asarray(vcache["v"]),
+                                      np.asarray(ref_cache["v"]))
+
+    def test_paged_verify_matches_sequential(self, model):
+        cfg, params = model
+        S, L, W, bs, nb = 2, 5, 4, 4, 16
+        max_blocks = 4                       # 16 positions per slot
+        cache = init_paged_kv_cache(cfg, nb, bs, S)
+        tables = np.full((S, max_blocks), TRASH_BLOCK, np.int32)
+        tables[0] = [1, 2, 3, 4]
+        tables[1] = [5, 6, 7, 8]
+        pre = jax.jit(lambda p, t, c, s, wr: paged_prefill(
+            p, t, c, s, wr, cfg, length=L))
+        rng = np.random.RandomState(1)
+        plog = []
+        for s in range(S):
+            toks = rng.randint(0, cfg.vocab, (8,)).astype(np.int32)
+            cache, lg = pre(params, toks, cache, s, tables[s])
+            plog.append(np.asarray(lg)[L - 1])
+        last = np.stack(plog).argmax(-1).astype(np.int32)
+        pos = np.full((S,), L, np.int32)
+
+        dec = jax.jit(lambda p, t, c, q, bt: paged_decode_step(
+            p, t, c, q, bt, cfg))
+        cache_d, last_d, pos_d = cache, last.copy(), pos.copy()
+        ref_logits = []
+        drafts = [last.copy()]
+        for _ in range(W):
+            cache_d, lg = dec(params, last_d, cache_d, pos_d, tables)
+            lg = np.asarray(lg)
+            ref_logits.append(lg)
+            last_d = lg.argmax(-1).astype(np.int32)
+            drafts.append(last_d.copy())
+            pos_d = pos_d + 1
+        drafts = np.stack(drafts[:W], axis=1)
+
+        ver = jax.jit(lambda p, t, c, q, bt: paged_verify_step(
+            p, t, c, q, bt, cfg))
+        vcache, vlog = ver(params, drafts, cache, pos, tables)
+        np.testing.assert_array_equal(np.asarray(vlog),
+                                      np.stack(ref_logits, axis=1))
+        np.testing.assert_array_equal(np.asarray(vcache["k"]),
+                                      np.asarray(cache_d["k"]))
+        np.testing.assert_array_equal(np.asarray(vcache["v"]),
+                                      np.asarray(cache_d["v"]))
+
+    def test_verify_tail_past_max_len_is_dropped(self, model):
+        """Contiguous verify near the cache edge: writes at wpos >=
+        max_len ride XLA's drop-out-of-bounds scatter mode — rows
+        INSIDE the cache must come out exactly as a plain decode step
+        wrote them, with nothing wrapped or clobbered."""
+        cfg, params = model
+        S, max_len = 2, 8
+        cache = init_kv_cache(cfg, S, max_len)
+        pre = jax.jit(lambda p, t, c, s: prefill(p, t, c, s, cfg, length=6))
+        rng = np.random.RandomState(2)
+        for s in range(S):
+            cache, _ = pre(params,
+                           rng.randint(0, cfg.vocab, (8,)).astype(np.int32),
+                           cache, s)
+        pos = np.full((S,), 7, np.int32)     # one writable row left
+        last = np.array([3, 4], np.int32)
+        dec = jax.jit(lambda p, t, c, q: decode_step(p, t, c, q, cfg))
+        ref_cache, ref_lg = dec(params, last, cache, pos)
+        drafts = np.stack([last, np.array([9, 9], np.int32),
+                           np.array([11, 11], np.int32)], axis=1)
+        ver = jax.jit(lambda p, t, c, q: verify_step(p, t, c, q, cfg))
+        vcache, vlog = ver(params, drafts, cache, pos)
+        np.testing.assert_array_equal(np.asarray(vlog)[:, 0],
+                                      np.asarray(ref_lg))
+        np.testing.assert_array_equal(np.asarray(vcache["k"]),
+                                      np.asarray(ref_cache["k"]))
+
+
+# -- engine: digest identity, fallback, compile surface ---------------------
+
+
+PROMPTS = ([1, 2, 3, 1, 2, 3, 1, 2],        # self-repeating: drafts hit
+           [5, 6, 7, 8],
+           [9, 9, 9, 9, 9])
+
+
+def _collect(eng, temperature=0.0, seed=11, max_new=10):
+    hs = [eng.submit(p, max_new_tokens=max_new,
+                     sampling=serve.SamplingParams(
+                         temperature=temperature,
+                         top_k=8 if temperature > 0 else 0,
+                         seed=seed + i))
+          for i, p in enumerate(PROMPTS)]
+    return [h.result(timeout=120) for h in hs]
+
+
+@pytest.fixture(scope="module")
+def engines(model):
+    """Lazy per-layout engine pairs (plain, spec) over the shared
+    params; nothing warms up — lazy compiles cover only the buckets the
+    prompts touch."""
+    cfg, params = model
+    built = {}
+
+    def lora_reg():
+        lora = LoraConfig(rank=2)
+        reg = serve.AdapterRegistry(cfg, lora, capacity=2)
+        reg.load("a0", init_adapter(jax.random.PRNGKey(100), cfg, lora,
+                                    b_scale=0.5))
+        return reg
+
+    def get(layout, spec=None, **kw):
+        key = (layout, None if spec is None else id(spec))
+        if key not in built:
+            gkw = dict(max_slots=2, max_len=32, default_max_new_tokens=10)
+            if layout.startswith("paged"):
+                gkw.update(kv_layout="paged", block_size=4, n_blocks=64)
+            built[key] = serve.GenerationEngine(
+                params, cfg, serve.GenerationConfig(**gkw),
+                adapters=(lora_reg() if layout == "paged_adapter"
+                          else None),
+                spec=spec, **kw)
+        return built[key]
+
+    yield get
+    for eng in built.values():
+        eng.shutdown(drain=False)
+
+
+SPEC = SpecConfig(k=4)
+
+
+class TestEngineDigests:
+    @pytest.mark.parametrize("layout", ["contiguous", "paged",
+                                        "paged_adapter"])
+    def test_greedy_streams_identical_spec_vs_plain(self, engines, layout):
+        plain, spec = engines(layout), engines(layout, SPEC)
+        kw = {"adapter": "a0"} if layout == "paged_adapter" else {}
+        for p in PROMPTS:
+            a = plain.submit(p, max_new_tokens=10, **kw).result(120)
+            b = spec.submit(p, max_new_tokens=10, **kw).result(120)
+            assert a["tokens"] == b["tokens"], (layout, p)
+            assert a["finish_reason"] == b["finish_reason"]
+            assert a["spec_accept_rate"] is None
+            assert b["spec_accept_rate"] is not None
+
+    def test_acceptance_fires_on_repetitive_prompt(self, engines):
+        spec = engines("contiguous", SPEC)
+        _collect(spec)
+        snap = spec.stats()
+        sp = snap["spec"]
+        assert snap["spec_k"] == 4
+        assert sp["draft_tokens_total"] > 0
+        assert sp["accept_rate"] > 0
+        assert sp["tokens_per_step"] > 1.0
+        assert sp["emitted_tokens_total"] > sp["steps_total"]
+
+    def test_sampled_streams_run_to_run_deterministic(self, engines):
+        spec = engines("contiguous", SPEC)
+        a = _collect(spec, temperature=0.8)
+        b = _collect(spec, temperature=0.8)
+        assert [r["tokens"] for r in a] == [r["tokens"] for r in b]
+
+    def test_hostile_drafter_cannot_change_a_stream(self, engines, model):
+        """Acceptance-0 path: a drafter proposing garbage (plus
+        out-of-vocab ids the engine must filter) costs wasted verify
+        rows, never a token. Liveness: every step still emits >= 1."""
+        cfg, _ = model
+
+        class Hostile:
+            def propose(self, context, k):
+                return np.array([cfg.vocab - 1 - int(context[-1]) % 2,
+                                 cfg.vocab + 7, -3], np.int64)[:k]
+
+        plain = engines("contiguous")
+        bad = engines("contiguous", SpecConfig(k=3, drafter=Hostile()))
+        for p in PROMPTS:
+            a = plain.submit(p, max_new_tokens=10).result(120)
+            b = bad.submit(p, max_new_tokens=10).result(120)
+            assert a["tokens"] == b["tokens"], p
+
+    def test_empty_drafter_falls_back_to_plain_decode(self, engines):
+        """A drafter with nothing to say must leave the engine on the
+        ONE-TOKEN decode program — speculation is never a liveness
+        dependency — while spec accounting still counts the steps."""
+        class Mute:
+            def propose(self, context, k):
+                return np.empty((0,), np.int64)
+
+        eng = engines("contiguous", SpecConfig(k=2, drafter=Mute()))
+        plain = engines("contiguous")
+        for p in PROMPTS:
+            a = plain.submit(p, max_new_tokens=6).result(120)
+            b = eng.submit(p, max_new_tokens=6).result(120)
+            assert a["tokens"] == b["tokens"]
+        sp = eng.stats()["spec"]
+        assert sp["steps_total"] > 0 and sp["draft_tokens_total"] == 0
+        assert sp["tokens_per_step"] == 1.0
+
+    def test_compile_cache_grows_by_exactly_one_verify_bucket(
+            self, engines):
+        """The compile-surface pin: after identical traffic, the spec
+        engine's executable set is the plain engine's plus exactly ONE
+        key — ("verify", k+1)."""
+        plain, spec = engines("contiguous"), engines("contiguous", SPEC)
+        _collect(plain)
+        _collect(spec)
+        extra = set(spec._compiled) - set(plain._compiled)
+        assert extra == {("verify", SPEC.k + 1)}, extra
+        assert set(plain._compiled) - set(spec._compiled) == set()
+
+    def test_spec_refuses_paged_kernel_and_oversized_k(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="paged_kernel"):
+            serve.GenerationEngine(
+                params, cfg,
+                serve.GenerationConfig(max_slots=2, max_len=32,
+                                       kv_layout="paged", block_size=4,
+                                       paged_kernel=True),
+                spec=SpecConfig(k=2))
+        with pytest.raises(ValueError, match="max_len"):
+            serve.GenerationEngine(
+                params, cfg,
+                serve.GenerationConfig(max_slots=2, max_len=4),
+                spec=SpecConfig(k=4))
