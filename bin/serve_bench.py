@@ -114,6 +114,22 @@ _GEN_MODEL = dict(vocab=256, d_model=64, n_heads=4, n_layers=2, d_ff=128)
 _GEN_BYTES_PER_TOKEN = 2 * _GEN_MODEL["n_layers"] * _GEN_MODEL["d_model"] * 4
 
 
+def _gen_model(args):
+    """Bench model dims. ``--model-dim`` widens the model (d_ff = 2·d)
+    so a drill can sit in the regime the KV hierarchy is built for:
+    prefill compute per chunk much larger than a block copy, as on a
+    real accelerator. Default keeps the historical tiny model."""
+    d = int(getattr(args, "model_dim", 0) or 0)
+    if not d:
+        return dict(_GEN_MODEL)
+    return dict(vocab=256, d_model=d, n_heads=4, n_layers=2, d_ff=2 * d)
+
+
+def _gen_bpt(args):
+    m = _gen_model(args)
+    return 2 * m["n_layers"] * m["d_model"] * 4
+
+
 def _gen_capacity(args):
     """Resolve (max_slots, n_blocks, cache_bytes) for the generate
     engine. With ``--cache-mb`` the budget is FIXED and capacity derives
@@ -131,11 +147,12 @@ def _gen_capacity(args):
     if not args.cache_mb:
         n_blocks = args.n_blocks if args.n_blocks else None
         return args.slots, n_blocks, None
+    bpt = _gen_bpt(args)
     budget = int(args.cache_mb * 2 ** 20)
     if args.kv_layout == "contiguous":
-        slots = max(1, budget // (args.max_len * _GEN_BYTES_PER_TOKEN))
-        return slots, None, slots * args.max_len * _GEN_BYTES_PER_TOKEN
-    block_bytes = args.block_size * _GEN_BYTES_PER_TOKEN
+        slots = max(1, budget // (args.max_len * bpt))
+        return slots, None, slots * args.max_len * bpt
+    block_bytes = args.block_size * bpt
     n_blocks = max(2, budget // block_bytes)
     # Typical request: the longest bench prompt (prefix + 16) plus the
     # generated tokens (the last sampled token needs no cache write).
@@ -226,7 +243,7 @@ def _build_gen_engine(args):
 
     # Small but real: the bench measures the serving plane (slot churn,
     # prefill/decode interleave, streaming), not model quality.
-    cfg = TransformerConfig(**_GEN_MODEL, dtype=jnp.float32,
+    cfg = TransformerConfig(**_gen_model(args), dtype=jnp.float32,
                             unembed_dtype=jnp.float32, attn_backend="xla")
     params = init_params(jax.random.PRNGKey(0), cfg)
     slots, n_blocks, cache_bytes = _gen_capacity(args)
@@ -237,14 +254,18 @@ def _build_gen_engine(args):
         kv_layout=args.kv_layout,
         **({"block_size": args.block_size, "n_blocks": n_blocks,
             "prefix_reuse": args.prefix_reuse,
-            "paged_kernel": args.paged_kernel}
+            "paged_kernel": args.paged_kernel,
+            "chunked_prefill": args.chunked_prefill,
+            "chunk_blocks": args.chunk_blocks,
+            "host_blocks": args.host_blocks,
+            "host_admission": args.host_admission}
            if args.kv_layout == "paged" else {}))
     if cache_bytes is None:
         if args.kv_layout == "paged":
             cache_bytes = (gcfg.resolved_n_blocks * gcfg.block_size
-                           * _GEN_BYTES_PER_TOKEN)
+                           * _gen_bpt(args))
         else:
-            cache_bytes = slots * args.max_len * _GEN_BYTES_PER_TOKEN
+            cache_bytes = slots * args.max_len * _gen_bpt(args)
     lora, adapter_trees = _bench_adapters(args, cfg)
     spec_cfg = serve.SpecConfig(k=args.spec_k) if args.spec_k else None
 
@@ -269,7 +290,7 @@ def _build_gen_engine(args):
         if args.replica_procs:
             import dataclasses
             spec = {
-                "model": dict(_GEN_MODEL, dtype="float32",
+                "model": dict(_gen_model(args), dtype="float32",
                               unembed_dtype="float32",
                               attn_backend="xla"),
                 "seed": 0,
@@ -354,12 +375,23 @@ def run_gen_point(eng, qps: float, duration: float,
     ``(row, streams_by_tenant)``."""
     from horovod_tpu.exceptions import (DeadlineExceededError,
                                         ServerOverloadedError)
+    gen0 = eng.stats().get("generation") or {}
     n = max(1, int(qps * duration))
     period = 1.0 / qps
     # Deterministic across runs and independent of the arrival RNG, so
     # reuse-on vs reuse-off runs see the SAME system prompt.
-    sys_prefix = np.random.RandomState(1234).randint(
-        1, 255, size=args.prefix_tokens).tolist()
+    # --prefix-count rotates round-robin over K distinct prefixes (the
+    # first one keeps the historical seed, so count=1 digests are
+    # unchanged); K long prefixes make the registered working set
+    # exceed a tight device pool and exercise offload/prefetch.
+    sys_prefixes = [np.random.RandomState(1234 if j == 0 else 4100 + j)
+                    .randint(1, 255, size=args.prefix_tokens).tolist()
+                    for j in range(max(1, args.prefix_count))]
+    # --prefix-mix: which arrivals carry the shared system prompt. A
+    # DEDICATED seeded RNG, drawn every arrival regardless of the
+    # verdict, so the tenant/prompt streams (and their digests) are
+    # identical across mix settings.
+    mix_rng = np.random.RandomState(97)
     tenants, weights = _bench_tenants(args)
     # Tenant selection and per-tenant prompts ride their own RNGs; the
     # base-only path keeps drawing prompts from the caller's rng so the
@@ -371,6 +403,8 @@ def run_gen_point(eng, qps: float, duration: float,
     handles = []
     overload = 0
     sent_by_tenant = {t: 0 for t in tenants}
+    shared_sent = 0
+    seen_prefixes = set()
     start = time.monotonic()
     for i in range(n):
         delay = start + i * period - time.monotonic()
@@ -379,10 +413,25 @@ def run_gen_point(eng, qps: float, duration: float,
         t = (tenants[0] if len(tenants) == 1
              else tenants[pick_rng.choice(len(tenants), p=weights)])
         trng = prompt_rngs[t]
-        prompt = sys_prefix + trng.randint(
+        draw = mix_rng.random_sample()
+        shared = args.prefix_tokens > 0 and draw < args.prefix_mix
+        pfx_idx = shared_sent % len(sys_prefixes)
+        if shared:
+            shared_sent += 1
+        prompt = (sys_prefixes[pfx_idx] if shared else []) + trng.randint(
             1, 255, size=trng.randint(4, 17)).tolist()
         if args.adapter_only and t != args.adapter_only:
             continue        # reference run: same schedule, one tenant
+        # Hit-vs-cold TTFT split: the FIRST shared-prefix arrival of
+        # the point pays the cold prefill (it registers the prefix);
+        # later shared arrivals should prefill only their suffix. A
+        # second operating point on the same engine inherits the
+        # registry, so its "cold" sample is really a hit — the split is
+        # a smoke number; prefix_hit_rate is the precise check.
+        cls = "cold"
+        if shared:
+            cls = "cold" if pfx_idx not in seen_prefixes else "hit"
+            seen_prefixes.add(pfx_idx)
         sent_by_tenant[t] += 1
         try:
             kw = {} if t == "base" else {"adapter": t}
@@ -396,18 +445,20 @@ def run_gen_point(eng, qps: float, duration: float,
                 kw["sampling"] = SamplingParams(
                     temperature=args.temperature, top_k=args.top_k,
                     seed=9000 + 131 * tenants.index(t) + sent_by_tenant[t])
-            handles.append((t, eng.submit(prompt, **kw)))
+            handles.append((t, cls, eng.submit(prompt, **kw)))
         except ServerOverloadedError:
             overload += 1
     ttft_ms, tps_user, tokens_out = [], [], 0
+    ttft_cls = {"hit": [], "cold": []}
     expired, failed = 0, 0
     streams = []
     streams_by_tenant = {t: [] for t in tenants}
     done_by_tenant = {t: 0 for t in tenants}
-    for t, h in handles:
+    for t, cls, h in handles:
         try:
             r = h.result(timeout=120)
             ttft_ms.append(r["ttft_ms"])
+            ttft_cls[cls].append(r["ttft_ms"])
             tokens_out += r["n_tokens"]
             streams.append(tuple(r["tokens"]))
             streams_by_tenant[t].append(tuple(r["tokens"]))
@@ -452,6 +503,31 @@ def run_gen_point(eng, qps: float, duration: float,
         "prefix_hits_total": gen["prefix_hits_total"],
         "prefix_misses_total": gen["prefix_misses_total"],
         "prefix_hit_blocks_total": gen["prefix_hit_blocks_total"],
+        # KV memory hierarchy (chunked prefill + host tier): the
+        # per-point hit rate from the counter DELTAS (the cumulative
+        # totals above smear points), the hit-vs-cold TTFT split of
+        # THIS point's completed requests, and the tier traffic. None
+        # where a class saw no completion (json-clean, never NaN).
+        "prefix_mix": args.prefix_mix,
+        "prefix_count": max(1, args.prefix_count),
+        "prefix_hit_rate": (
+            lambda h, m: (h / (h + m)) if (h + m) > 0 else None)(
+                gen["prefix_hits_total"]
+                - gen0.get("prefix_hits_total", 0),
+                gen["prefix_misses_total"]
+                - gen0.get("prefix_misses_total", 0)),
+        "ttft_hit_p50_ms": (_percentile(ttft_cls["hit"], 0.50)
+                            if ttft_cls["hit"] else None),
+        "ttft_cold_p50_ms": (_percentile(ttft_cls["cold"], 0.50)
+                             if ttft_cls["cold"] else None),
+        "chunked_prefill": bool(snap.get("chunked_prefill", False)),
+        "host_blocks": args.host_blocks,
+        "kv_offload_blocks_total": gen.get("kv_offload_blocks_total", 0),
+        "kv_prefetch_blocks_total": gen.get("kv_prefetch_blocks_total", 0),
+        "prefill_chunks_total": gen.get("prefill_chunks_total", 0),
+        "prefill_chunks_skipped_total":
+            gen.get("prefill_chunks_skipped_total", 0),
+        "last_prefill_bucket": snap.get("last_prefill_bucket"),
         "stream_digest": digest,
         # Multi-tenant adapter fields — stamped in EVERY generate row
         # (zeros/base-only when --adapters is off) so a consumer never
@@ -495,6 +571,8 @@ def run_gen_point(eng, qps: float, duration: float,
         row["stranded"] = snap["fleet"]["streams_stranded_total"]
         if "adapter_dispatch" in snap["fleet"]:
             row["adapter_dispatch"] = snap["fleet"]["adapter_dispatch"]
+        if "prefix_dispatch" in snap["fleet"]:
+            row["prefix_dispatch"] = snap["fleet"]["prefix_dispatch"]
     return row, streams_by_tenant
 
 
@@ -603,6 +681,42 @@ def main():
                    help="[generate] fixed system-prompt tokens prepended "
                         "to every request (the prefix-reuse traffic "
                         "shape)")
+    p.add_argument("--model-dim", type=int, default=0,
+                   help="override the bench model width (d_ff = 2*dim; "
+                        "0 keeps the default tiny model). Wider models "
+                        "put the bench in the regime where prefill "
+                        "compute dominates KV block copies")
+    p.add_argument("--prefix-count", type=int, default=1,
+                   help="number of distinct shared system prefixes rotated "
+                        "round-robin across shared arrivals. >1 grows the "
+                        "registered-prefix working set past a tight device "
+                        "pool so the host tier's offload/prefetch path runs")
+    p.add_argument("--prefix-mix", type=float, default=1.0,
+                   help="[generate, --prefix-tokens] fraction of "
+                        "requests carrying the shared system prompt "
+                        "(default 1.0 = all, the old behavior); the JSON "
+                        "row stamps the per-point prefix hit rate and "
+                        "the hit-vs-cold TTFT split")
+    p.add_argument("--chunked-prefill", action="store_true",
+                   help="[generate, paged, --prefix-reuse] chunked "
+                        "prefill: the compiled program starts at the "
+                        "first non-shared block, reading hit blocks' "
+                        "K/V from the pool instead of recomputing "
+                        "(docs/inference.md 'KV memory hierarchy')")
+    p.add_argument("--chunk-blocks", type=int, default=1,
+                   help="[generate, --chunked-prefill] blocks per "
+                        "prefill scan chunk (power of two)")
+    p.add_argument("--host-blocks", type=int, default=0,
+                   help="[generate, paged, --prefix-reuse] host-tier "
+                        "block pool: cold registered-prefix blocks "
+                        "offload to pinned host memory and prefetch "
+                        "back at admission (0 = device-only)")
+    p.add_argument("--host-admission", choices=("wait", "miss"),
+                   default="wait",
+                   help="[generate, --host-blocks] admission policy "
+                        "while a host-tier prefetch is in flight: wait "
+                        "(hold the request for the full hit) or miss "
+                        "(admit now, recompute the prefix)")
     p.add_argument("--adapters", type=int, default=0,
                    help="[generate] seeded LoRA fine-tunes (tenants "
                         "a0..aN-1) loaded next to the base model; every "
@@ -746,6 +860,33 @@ def main():
         faults.reset()
     if args.adapter_mix and not args.adapters:
         p.error("--adapter-mix needs --adapters N")
+    if not 0.0 <= args.prefix_mix <= 1.0:
+        p.error("--prefix-mix must be in [0, 1]")
+    if args.model_dim and (args.model_dim < 4 or args.model_dim % 4):
+        p.error("--model-dim must be a positive multiple of 4 (the "
+                "bench model has 4 heads)")
+    if args.prefix_count < 1:
+        p.error("--prefix-count must be >= 1")
+    if args.prefix_count > 1 and not args.prefix_tokens:
+        p.error("--prefix-count > 1 needs --prefix-tokens N")
+    if args.prefix_mix != 1.0:
+        if args.mode != "generate":
+            p.error("--prefix-mix applies to --mode generate only")
+        if not args.prefix_tokens:
+            p.error("--prefix-mix needs --prefix-tokens N (without a "
+                    "shared system prompt there is nothing to mix)")
+    if args.chunked_prefill or args.host_blocks:
+        what = "--chunked-prefill" if args.chunked_prefill \
+            else "--host-blocks"
+        if args.mode != "generate" or args.kv_layout != "paged":
+            p.error(f"{what} needs --mode generate --kv-layout paged")
+        if not args.prefix_reuse:
+            p.error(f"{what} needs --prefix-reuse (its whole point is "
+                    f"the prefix cache)")
+    if args.chunk_blocks < 1:
+        p.error("--chunk-blocks must be >= 1")
+    if args.host_blocks < 0:
+        p.error("--host-blocks must be >= 0")
     if args.mode == "generate":
         try:
             # ONE naming/weights rule — the same call the run schedule
@@ -840,6 +981,8 @@ def _fleet_settle(eng, args, lost_streams: int, streams_by_tenant=None):
                                  for t, s in streams_by_tenant.items()}
     if "adapter_dispatch" in snap["fleet"]:
         row["adapter_dispatch"] = snap["fleet"]["adapter_dispatch"]
+    if "prefix_dispatch" in snap["fleet"]:
+        row["prefix_dispatch"] = snap["fleet"]["prefix_dispatch"]
     return row
 
 

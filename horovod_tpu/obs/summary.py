@@ -203,6 +203,16 @@ class FleetPoller:
             if name == "hvd_fleet_adapters_resident":
                 parts.append(f"adapters={int(v)} resident")
                 break
+        # Prefix-cache effectiveness: hit share of all prefix lookups,
+        # from the SAME merged parse (one scrape per endpoint per poll,
+        # the PR-13 rule) — shown only once some lookup happened, so a
+        # fleet without prefix reuse keeps its old line.
+        hits = sum(v for (name, _), v in merged.items()
+                   if name == "hvd_prefix_hits_total")
+        lookups = hits + sum(v for (name, _), v in merged.items()
+                             if name == "hvd_prefix_misses_total")
+        if lookups > 0:
+            parts.append(f"prefix={100.0 * hits / lookups:.0f}%")
         buckets: Dict[str, float] = {}
         for (name, labels), v in merged.items():
             if name == "hvd_generate_ttft_seconds_bucket":

@@ -46,10 +46,12 @@ rows-beyond-length contract).
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -172,6 +174,124 @@ def paged_prefill(params, tokens, cache: Dict, slot, write_row,
                 .astype(v_pool.dtype)[None, None], idx)
 
     logits = _prompt_forward(params, tokens, cfg, store, delta=delta)
+    lengths = cache["lengths"].at[slot].set(length)
+    return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
+
+
+def paged_chunked_prefill(params, tokens, cache: Dict, slot, write_rows,
+                          read_row, start, cfg: TransformerConfig,
+                          length=None, chunk_blocks: int = 1, *,
+                          adapters=None, adapter_idx=None,
+                          lora=None) -> Tuple[Dict, Any]:
+    """Chunked prefill: a ``lax.scan`` over fixed-shape chunks of
+    ``C = chunk_blocks · block_size`` tokens whose attention reads K/V
+    back OUT of the block pool through ``read_row`` — so a prefix-hit
+    admission runs a SUFFIX-sized program that never recomputes the
+    shared blocks, and a cold admission is the same program started at
+    block 0.
+
+    Args:
+      tokens: [B] int32 at a compiled chunked bucket (``B % C == 0`` and
+        ``B >= 2·C`` — see the unroll note below).
+      write_rows: [B//C, chunk_blocks] int32 — physical block per chunk
+        position; shared-prefix and padding entries point at
+        :data:`TRASH_BLOCK` (the prefill write-hygiene contract).
+      read_row: [max_blocks] int32 — the slot's FULL chain (hit blocks
+        first, then the fresh blocks ``write_rows`` names), TRASH-padded.
+      start: int32 scalar (traced) — absolute position of ``tokens[0]``;
+        block-aligned (``hits · block_size``); 0 for a cold admission.
+      length: true TOTAL sequence length (prefix + suffix; defaults to
+        ``B``).
+
+    Returns ``(cache', logits [B, vocab] f32)`` where row ``i`` scores
+    absolute position ``start + i``.
+
+    Bitwise contract: cold and hit admissions scan the IDENTICAL
+    fixed-shape body jaxpr (chunk attention is always ``[C,
+    max_blocks·block_size]`` against the gathered pool), so each trip
+    compiles to the identical program and by induction suffix logits and
+    freshly written pool bytes are BITWISE equal to the full-prompt
+    scan's. This is a deliberately different numeric path from
+    :func:`paged_prefill` (whose flash-attention logits are shape- and
+    fusion-sensitive across bucket widths on XLA): a chunked engine is
+    bit-identical to itself across hit depths, not to the non-chunked
+    layouts. ``B >= 2·C`` is load-bearing — XLA fully unrolls a
+    trip-count-1 ``scan`` and re-fuses the body, breaking the
+    identical-program induction, so the engine never compiles a
+    one-chunk bucket.
+    """
+    _check_dense(cfg, "paged_chunked_prefill")
+    from .lora import make_delta
+    delta = make_delta("prompt", adapters,
+                       -1 if adapter_idx is None else adapter_idx,
+                       lora, cfg)
+    params = _gen_weights(params)
+    B = tokens.shape[0]
+    bs = cache["k"].shape[2]
+    C = int(chunk_blocks) * bs
+    if B % C or B < 2 * C:
+        raise ValueError(
+            f"chunked bucket {B} must be a multiple of chunk size {C} "
+            f"and at least 2 chunks (XLA unrolls one-trip scans, which "
+            f"breaks the hit-vs-cold bitwise contract)")
+    n_chunks = B // C
+    max_blocks = read_row.shape[0]
+    if B > max_blocks * bs:
+        raise ValueError(
+            f"chunked bucket {B} exceeds the table depth "
+            f"{max_blocks} blocks × {bs}")
+    M = max_blocks * bs
+    d_head = cfg.d_model // cfg.n_heads
+    sm_scale = float(d_head) ** -0.5
+    length = jnp.asarray(B if length is None else length, jnp.int32)
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)     # x64 mode: indices must agree
+    kpos = jnp.arange(M, dtype=jnp.int32)
+
+    def body(carry, xs):
+        k_pool, v_pool = carry
+        toks_c, wblocks, cstart = xs    # [C], [chunk_blocks], scalar
+        qpos = cstart + jnp.arange(C, dtype=jnp.int32)
+
+        def store(li, k, v):
+            nonlocal k_pool, v_pool
+            li32 = jnp.asarray(li, jnp.int32)
+            for j in range(chunk_blocks):
+                idx = (li32, wblocks[j], zero, zero, zero)
+                k_pool = lax.dynamic_update_slice(
+                    k_pool, k[j * bs:(j + 1) * bs]
+                    .astype(k_pool.dtype)[None, None], idx)
+                v_pool = lax.dynamic_update_slice(
+                    v_pool, v[j * bs:(j + 1) * bs]
+                    .astype(v_pool.dtype)[None, None], idx)
+
+        def attend(li, q):
+            # Gathers AFTER store: the chunk attends over everything
+            # written so far (hit blocks included) plus itself; rows
+            # past qpos are masked exactly like _cached_attention.
+            kg = k_pool[li][read_row].reshape(
+                M, cfg.n_heads, d_head)[None]
+            vg = v_pool[li][read_row].reshape(
+                M, cfg.n_heads, d_head)[None]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           kg.astype(jnp.float32)) * sm_scale
+            s = jnp.where(
+                qpos[None, None, :, None] >= kpos[None, None, None, :],
+                s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p,
+                              vg.astype(jnp.float32))
+
+        logits_c = _prompt_forward(params, toks_c, cfg, store,
+                                   delta=delta, attend=attend)
+        return (k_pool, v_pool), logits_c
+
+    toks = tokens.reshape(n_chunks, C)
+    cstarts = start + jnp.arange(n_chunks, dtype=jnp.int32) * C
+    (k_pool, v_pool), logits = lax.scan(
+        body, (cache["k"], cache["v"]), (toks, write_rows, cstarts))
+    logits = logits.reshape(B, -1)
     lengths = cache["lengths"].at[slot].set(length)
     return {"k": k_pool, "v": v_pool, "lengths": lengths}, logits
 
@@ -324,6 +444,27 @@ def paged_verify_step(params, draft_tokens, cache: Dict, positions,
 # ---------------------------------------------------------------------------
 
 
+def prefix_route_digest(tokens, block_size: int,
+                        adapter: Optional[str] = None) -> Optional[str]:
+    """Stable 16-hex digest of a prompt's FIRST full block under the
+    tenant frame — the prefix-affine routing key.
+
+    The frame mirrors the registry salt's tenant framing (``\\x00`` for
+    base, ``"{adapter}\\x00"`` for a tenant) so two tenants' identical
+    token blocks never share a digest. The adapter load-GENERATION is
+    deliberately excluded: the digest is advisory placement only — a
+    replica whose registry was salted under an older generation simply
+    misses and recomputes, so a post-reload stale digest costs a cache
+    miss, never a wrong byte. Returns ``None`` when the prompt has no
+    full first block (nothing registerable → nothing to route on).
+    """
+    if len(tokens) < block_size:
+        return None
+    frame = b"\x00" if adapter is None else f"{adapter}\x00".encode()
+    blk = np.ascontiguousarray(tokens[:block_size], dtype=np.int32)
+    return hashlib.sha256(frame + blk.tobytes()).hexdigest()[:16]
+
+
 class BlockManager:
     """Host-side allocator for the paged pool: free list + per-block
     refcounts + a prefix registry for copy-on-write prompt sharing.
@@ -350,7 +491,8 @@ class BlockManager:
     tenant's before/after a hot-reload.
     """
 
-    def __init__(self, n_blocks: int, block_size: int):
+    def __init__(self, n_blocks: int, block_size: int,
+                 host_blocks: int = 0):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is reserved), got "
@@ -361,6 +503,13 @@ class BlockManager:
         self._ref[TRASH_BLOCK] = 1          # never allocated, never freed
         self._free: List[int] = list(range(self._n - 1, 0, -1))
         self._registry: "OrderedDict[bytes, int]" = OrderedDict()
+        # Host tier: registry key -> opaque payload (the engine stages
+        # the block's K/V bytes; the manager only does LRU accounting).
+        self._host_cap = int(host_blocks)
+        self._host: "OrderedDict[bytes, Any]" = OrderedDict()
+        # First-block registry key -> advisory routing digest; kept
+        # while the chain head lives in EITHER tier.
+        self._route: Dict[bytes, str] = {}
         self._lock = threading.Lock()
 
     # -- gauges ------------------------------------------------------------
@@ -390,12 +539,19 @@ class BlockManager:
             return len(self._registry)
 
     def gauges(self) -> Dict:
-        """The /stats block-pool block: plain ints, json-ready."""
+        """The /stats block-pool block: plain ints, json-ready (the
+        router sums these across replicas, so every value stays
+        numeric). Host-tier keys are present even at ``host_blocks=0``
+        so the exposition is stable across configurations."""
         with self._lock:
             free = len(self._free)
+            host_used = len(self._host)
             return {"total": self.usable, "free": free,
                     "used": self.usable - free,
-                    "registered_prefix_blocks": len(self._registry)}
+                    "registered_prefix_blocks": len(self._registry),
+                    "host_total": self._host_cap,
+                    "host_used": host_used,
+                    "host_free": max(0, self._host_cap - host_used)}
 
     # -- allocation --------------------------------------------------------
 
@@ -461,12 +617,21 @@ class BlockManager:
             return hits
 
     def register_prefix(self, tokens: np.ndarray, blocks: List[int],
-                        n_full: int, salt: bytes = b"") -> None:
+                        n_full: int, salt: bytes = b"",
+                        route_digest: Optional[str] = None) -> None:
         """Pin the prompt's first ``n_full`` blocks in the registry
-        under ``salt`` (idempotent for already-registered chains)."""
+        under ``salt`` (idempotent for already-registered chains).
+        ``route_digest`` tags the chain's FIRST block key for
+        prefix-affine routing. A cold re-registration supersedes any
+        host-tier copy of the same key (bitwise-identical bytes by the
+        chunked-prefill contract, so the device copy wins and the host
+        slot frees up)."""
         with self._lock:
             for j in range(n_full):
                 key = self._key(tokens, j, salt)
+                if j == 0 and route_digest:
+                    self._route[key] = route_digest
+                self._host.pop(key, None)
                 if key in self._registry:
                     self._registry.move_to_end(key)
                     continue
@@ -491,6 +656,92 @@ class BlockManager:
                 blk = self._registry[key]
                 if self._ref[blk] == 1:
                     del self._registry[key]
+                    if key not in self._host:
+                        self._route.pop(key, None)
                     self._ref[blk] = 0
                     self._free.append(blk)
             return len(self._free) >= need_free
+
+    # -- host tier ---------------------------------------------------------
+
+    def host_lookup(self, tokens: np.ndarray, start_block: int,
+                    salt: bytes = b"") -> List[Tuple[bytes, Any]]:
+        """Contiguous run of host-tier entries continuing the device
+        chain from logical block ``start_block`` — ``[(key, payload),
+        ...]`` in chain order, touched MRU. The engine kicks an async
+        prefetch for these; they are NOT readable by this admission."""
+        with self._lock:
+            out: List[Tuple[bytes, Any]] = []
+            for j in range(int(start_block), len(tokens) // self._bs):
+                key = self._key(tokens, j, salt)
+                payload = self._host.get(key)
+                if payload is None:
+                    break
+                self._host.move_to_end(key)
+                out.append((key, payload))
+            return out
+
+    def offload_candidates(self, n: int) -> List[Tuple[bytes, int]]:
+        """Up to ``n`` coldest registry entries whose block's SOLE
+        reference is the registry pin — the only ones whose device bytes
+        are stable to copy (no stream can be writing them) and whose
+        eviction frees a block. Read-only: the engine snapshots the
+        bytes, then :meth:`offload_commit` re-validates under the lock,
+        so a hit that lands mid-copy simply cancels the offload."""
+        if self._host_cap <= 0 or n <= 0:
+            return []
+        with self._lock:
+            out: List[Tuple[bytes, int]] = []
+            for key, blk in self._registry.items():     # LRU → MRU
+                if len(out) >= n:
+                    break
+                if self._ref[blk] == 1:
+                    out.append((key, blk))
+            return out
+
+    def offload_commit(self, key: bytes, payload: Any) -> bool:
+        """Move a candidate to the host tier: drop the registry pin,
+        free the device block, stage ``payload`` LRU-tracked. Refuses
+        (returns False) if the entry was hit or evicted since
+        :meth:`offload_candidates` — the payload would be stale
+        bookkeeping, never a stale read, but we don't keep it."""
+        with self._lock:
+            blk = self._registry.get(key)
+            if blk is None or self._ref[blk] != 1 or self._host_cap <= 0:
+                return False
+            del self._registry[key]
+            self._ref[blk] = 0
+            self._free.append(blk)
+            self._host[key] = payload
+            self._host.move_to_end(key)
+            while len(self._host) > self._host_cap:
+                old, _ = self._host.popitem(last=False)
+                if old not in self._registry:
+                    self._route.pop(old, None)
+            return True
+
+    def promote(self, key: bytes, blk: int) -> bool:
+        """Install a prefetched payload's freshly written device block
+        back into the registry, transferring the caller's alloc ref to
+        the registry pin (the block arrives at refcount 1 from
+        :meth:`alloc` and stays at 1 — registry-pinned, stream-free).
+        Idempotent against the admission race: if the key was re-
+        registered cold while the prefetch was in flight, the new block
+        is freed and False returned — both copies hold bitwise-identical
+        bytes, so either outcome is correct and no reader ever sees a
+        stale row."""
+        with self._lock:
+            self._host.pop(key, None)
+            if key in self._registry:
+                self._ref[blk] = 0
+                self._free.append(blk)
+                return False
+            self._registry[key] = blk
+            return True
+
+    def route_digests(self) -> Tuple[str, ...]:
+        """Sorted unique advisory routing digests of every prefix chain
+        resident in EITHER tier — what the replica advertises through
+        /stats for prefix-affine dispatch."""
+        with self._lock:
+            return tuple(sorted(set(self._route.values())))

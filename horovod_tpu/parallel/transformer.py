@@ -377,7 +377,7 @@ def _no_delta(li, name, x, y):
 
 
 def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv,
-                    delta=None):
+                    delta=None, attend=None):
     """Shared prompt-phase forward for the contiguous and paged prefills
     (``params`` already through :func:`_gen_weights`): per layer the
     computed K/V is handed to ``store_kv(li, k, v)`` (k/v
@@ -387,7 +387,12 @@ def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv,
     construction (the cross-layout contract tests/test_paged_kv.py
     pins). ``delta(li, name, x, y)`` adjusts each target matmul's output
     (the LoRA hook; the default passes ``y`` through bit-unchanged).
-    Returns logits ``[T, vocab]`` f32."""
+    ``attend(li, q)`` replaces the self-contained causal attention with
+    a caller-supplied read (q ``[1, T, n_heads, d_head]`` → attn of the
+    same shape) — the chunked-prefill hook: ``store_kv`` runs FIRST, so
+    the hook may gather the just-stored rows back out of a paged pool
+    and attend across an arbitrary prefix span. Returns logits
+    ``[T, vocab]`` f32."""
     from ..ops.pallas_attention import flash_attention
     dl = _no_delta if delta is None else delta
     T = tokens.shape[0]
@@ -399,8 +404,12 @@ def _prompt_forward(params, tokens, cfg: TransformerConfig, store_kv,
         qkv = qkv.reshape(1, T, cfg.n_heads, 3, d_head)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         store_kv(li, k[0], v[0])
-        attn = flash_attention(q, k, v, causal=True,
-                               backend=cfg.attn_backend).astype(cfg.dtype)
+        if attend is not None:
+            attn = attend(li, q).astype(cfg.dtype)
+        else:
+            attn = flash_attention(
+                q, k, v, causal=True,
+                backend=cfg.attn_backend).astype(cfg.dtype)
         a_flat = attn.reshape(1, T, cfg.n_heads * d_head)
         x = x + dl(li, "wo", a_flat,
                    a_flat @ layer["wo"].astype(cfg.dtype))

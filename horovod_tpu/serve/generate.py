@@ -68,8 +68,10 @@ from ..exceptions import (DeadlineExceededError, ServerClosedError,
 from ..obs import flightrec
 from ..testing import faults
 from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
-                                  init_paged_kv_cache, paged_decode_step,
-                                  paged_prefill, paged_verify_step)
+                                  init_paged_kv_cache,
+                                  paged_chunked_prefill, paged_decode_step,
+                                  paged_prefill, paged_verify_step,
+                                  prefix_route_digest)
 from ..parallel.transformer import (TransformerConfig, decode_step,
                                     init_kv_cache, prefill, verify_step)
 from .adapters import AdapterRegistry
@@ -135,6 +137,26 @@ class GenerationConfig:
     decode attention through the Pallas paged kernel where supported
     (``ops.pallas_paged_attention``); off = the pure-lax gather
     fallback, the bit-identity reference, everywhere-green path.
+
+    ``chunked_prefill`` (paged + prefix_reuse) switches EVERY admission
+    to :func:`~horovod_tpu.parallel.kv_blocks.paged_chunked_prefill`: a
+    prefix-hit admission compiles/executes a SUFFIX-sized program that
+    reads the hit blocks' K/V out of the pool instead of recomputing
+    them, and a cold admission is the same scan started at block 0 — so
+    hit and cold streams stay bitwise identical (the chunked engine's
+    bit-identity reference is ITSELF, not the non-chunked layouts; see
+    the kv_blocks docstring). ``chunk_blocks`` is the scan's chunk width
+    in blocks; ``max_len`` must hold at least two chunks and divide
+    evenly by the chunk.
+
+    ``host_blocks`` (paged + prefix_reuse) adds a host-memory tier of
+    that many blocks: cold registered prefixes offload there instead of
+    being dropped at reclaim, and an admission whose chain continues in
+    the host tier kicks an async prefetch — the decode step NEVER
+    blocks on a fetch. ``host_admission`` picks what that admission does
+    meanwhile: ``"wait"`` holds it in the queue until the prefetch lands
+    (FIFO preserved), ``"miss"`` admits immediately with the device-tier
+    hits only (recompute, never a stale read).
     The rest mirrors :class:`~.engine.ServeConfig`'s backpressure
     contract."""
 
@@ -149,6 +171,10 @@ class GenerationConfig:
     n_blocks: Optional[int] = None
     prefix_reuse: bool = False
     paged_kernel: bool = False
+    chunked_prefill: bool = False
+    chunk_blocks: int = 1
+    host_blocks: int = 0
+    host_admission: str = "wait"
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -164,11 +190,24 @@ class GenerationConfig:
         if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
             raise ValueError(
                 f"block_size must be a power of two, got {self.block_size}")
+        if self.chunk_blocks < 1 or (self.chunk_blocks
+                                     & (self.chunk_blocks - 1)):
+            raise ValueError(
+                f"chunk_blocks must be a power of two, got "
+                f"{self.chunk_blocks}")
+        if self.host_blocks < 0:
+            raise ValueError(
+                f"host_blocks must be >= 0, got {self.host_blocks}")
+        if self.host_admission not in ("wait", "miss"):
+            raise ValueError(
+                f"host_admission must be 'wait' or 'miss', got "
+                f"{self.host_admission!r}")
         if self.kv_layout != "paged":
-            for knob in ("prefix_reuse", "paged_kernel"):
+            for knob in ("prefix_reuse", "paged_kernel", "chunked_prefill",
+                         "host_blocks"):
                 if getattr(self, knob):
                     raise ValueError(
-                        f"{knob}=True requires kv_layout='paged'")
+                        f"{knob} requires kv_layout='paged'")
             if self.n_blocks is not None:
                 raise ValueError(
                     "n_blocks applies to kv_layout='paged' only")
@@ -176,6 +215,27 @@ class GenerationConfig:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is the reserved trash "
                 f"block), got {self.n_blocks}")
+        if self.chunked_prefill:
+            if not self.prefix_reuse:
+                raise ValueError(
+                    "chunked_prefill=True requires prefix_reuse=True "
+                    "(its whole point is skipping prefix-hit compute)")
+            c = self.chunk_tokens
+            if self.max_len % c or self.max_len < 2 * c:
+                raise ValueError(
+                    f"chunked_prefill needs max_len divisible by the "
+                    f"chunk ({self.chunk_blocks} blocks × "
+                    f"{self.block_size} = {c} tokens) and at least two "
+                    f"chunks, got max_len={self.max_len}")
+        if self.host_blocks and not self.prefix_reuse:
+            raise ValueError(
+                "host_blocks > 0 requires prefix_reuse=True (only "
+                "registered prefixes ever offload)")
+
+    @property
+    def chunk_tokens(self) -> int:
+        """Tokens per chunked-prefill scan trip."""
+        return self.chunk_blocks * self.block_size
 
     @property
     def blocks_per_slot(self) -> int:
@@ -390,7 +450,8 @@ class GenerationEngine(ReadinessMixin):
             self._n_blocks = config.resolved_n_blocks
             self._cache = init_paged_kv_cache(
                 model_cfg, self._n_blocks, config.block_size, s)
-            self._blocks = BlockManager(self._n_blocks, config.block_size)
+            self._blocks = BlockManager(self._n_blocks, config.block_size,
+                                        host_blocks=config.host_blocks)
             max_blocks = config.blocks_per_slot
             self._tables = np.full((s, max_blocks), TRASH_BLOCK, np.int32)
             self._slot_blocks: List[List[int]] = [[] for _ in range(s)]
@@ -401,6 +462,14 @@ class GenerationEngine(ReadinessMixin):
         else:
             self._cache = init_kv_cache(model_cfg, s, config.max_len)
             self._blocks = None
+        self._chunked = self._paged and config.chunked_prefill
+        # Host-tier prefetch plumbing: entries staged by admission
+        # attempts, APPLIED at the top of each loop iteration — the
+        # decode step itself never waits on a host→device copy.
+        self._host_cap = config.host_blocks if self._paged else 0
+        self._prefetch_q: deque = deque()
+        self._prefetch_inflight: set = set()
+        self._last_prefill_bucket: Optional[int] = None
         # Speculative decoding plane (spec.py): draft k tokens host-side,
         # verify k+1 positions in one compiled program, accept per slot.
         # An optimization, never a liveness dependency — a step with no
@@ -421,6 +490,12 @@ class GenerationEngine(ReadinessMixin):
                     "path; set paged_kernel=False")
             self._drafter = spec.make_drafter()
         self._buckets = prefill_buckets(config.max_len)
+        # Chunked buckets are the SAME power-of-two grid restricted to
+        # multiples of the chunk holding >= 2 chunks (the scan-unroll
+        # floor), so the compile-cache count stays bounded by the grid.
+        c = config.chunk_tokens
+        self._chunked_buckets = tuple(
+            b for b in self._buckets if b % c == 0 and b >= 2 * c)
         # Requests popped from the admission queue but not yet in a slot
         # (the paged layout can be slot-free but block-starved; FIFO is
         # preserved — a head request short on blocks holds the line).
@@ -571,6 +646,37 @@ class GenerationEngine(ReadinessMixin):
                            + ([i32(s)] if has_ad else [])
                            + ([i32(s, nb)] if paged else []))
                     exe = jax.jit(_verify).lower(*sds).compile()
+                elif (isinstance(key, tuple)
+                        and key[0] == "chunked_prefill"):
+                    t = key[1]    # bucket width (multiple of the chunk)
+                    cb = self._cfg.chunk_blocks
+                    ct = self._cfg.chunk_tokens
+
+                    def _chunked(*a):
+                        it = iter(a)
+                        p = next(it)
+                        at = next(it) if has_ad else None
+                        toks, c, slot, length, start = (
+                            next(it), next(it), next(it), next(it),
+                            next(it))
+                        aidx = next(it) if has_ad else None
+                        wrows, rrow = next(it), next(it)
+                        c2, logits = paged_chunked_prefill(
+                            p, toks, c, slot, wrows, rrow, start, cfg,
+                            length=length, chunk_blocks=cb, adapters=at,
+                            adapter_idx=aidx, lora=lcfg)
+                        # Only the sampled row crosses back: the row
+                        # scoring the LAST prompt position, which sits
+                        # at suffix offset length - start - 1.
+                        return c2, logits[length - start - 1]
+                    # Same signature rule; the prefill scalars widen to
+                    # (slot, length, start) and the paged tail carries
+                    # the per-chunk write rows next to the read row.
+                    sds = ([p_sds] + ([a_sds] if has_ad else [])
+                           + [i32(t), c_sds, i32(), i32(), i32()]
+                           + ([i32()] if has_ad else [])
+                           + [i32(t // ct, cb), i32(nb)])
+                    exe = jax.jit(_chunked).lower(*sds).compile()
                 else:
                     t = key[1]
 
@@ -641,6 +747,27 @@ class GenerationEngine(ReadinessMixin):
             out = self._compile(("verify", w))(*args)
             jax.block_until_ready(out)
             spec_keys = (("verify", w),)
+        if self._chunked:
+            # A chunked engine never compiles the plain prefill — every
+            # admission (cold or hit) runs the chunked program, so only
+            # the chunked bucket grid is warmed.
+            ct = self._cfg.chunk_tokens
+            for t in self._chunked_buckets:
+                args = [self._params]
+                if has_ad:
+                    args.append(self._adapters.table())
+                args += [np.zeros((t,), np.int32), self._cache,
+                         np.asarray(0, np.int32), np.asarray(1, np.int32),
+                         np.asarray(0, np.int32)]
+                if has_ad:
+                    args.append(np.asarray(-1, np.int32))
+                args += [np.full((t // ct, self._cfg.chunk_blocks),
+                                 TRASH_BLOCK, np.int32),
+                         np.full((nb,), TRASH_BLOCK, np.int32)]
+                out = self._compile(("chunked_prefill", t))(*args)
+                jax.block_until_ready(out)
+            self._warmed = True
+            return ("decode",) + spec_keys + tuple(self._chunked_buckets)
         for t in self._buckets:
             args = [self._params]
             if has_ad:
@@ -875,6 +1002,11 @@ class GenerationEngine(ReadinessMixin):
             misses = snap["generation"]["prefix_misses_total"]
             snap["prefix_hit_rate"] = (hits / (hits + misses)
                                        if hits + misses else None)
+            snap["chunked_prefill"] = self._cfg.chunked_prefill
+            snap["prefix_digests"] = (
+                list(self._blocks.route_digests())
+                if self._cfg.prefix_reuse else [])
+        snap["last_prefill_bucket"] = self._last_prefill_bucket
         if self._adapters is not None:
             snap["adapters_resident"] = len(self._adapters.resident())
             snap["adapter_table"] = self._adapters.gauges()
@@ -903,6 +1035,21 @@ class GenerationEngine(ReadinessMixin):
         """Resident-adapter count for ``/healthz`` (None = no registry)."""
         names = self.adapter_names()
         return None if names is None else len(names)
+
+    def prefix_digests(self) -> Tuple[str, ...]:
+        """Advisory routing digests of the prefix chains this engine
+        holds (either tier) — the residency signal the fleet router's
+        prefix-affine dispatch sorts on. Empty for engines without a
+        prefix registry."""
+        if not (self._paged and self._cfg.prefix_reuse):
+            return ()
+        return self._blocks.route_digests()
+
+    @property
+    def route_block_size(self) -> int:
+        """Block size a dispatcher must use to compute a request's
+        routing digest so it matches this engine's advertised ones."""
+        return self._cfg.block_size
 
     def load_adapter(self, name: str, adapter: Any,
                      quota: Optional[int] = None) -> int:
@@ -1023,6 +1170,8 @@ class GenerationEngine(ReadinessMixin):
                     self._held.clear()
                     self._fail_active(err)
                     return
+                if self._prefetch_q:
+                    self._apply_prefetches()
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 n_active = self._cfg.max_slots - len(free)
                 idle = n_active == 0 and not self._held
@@ -1047,6 +1196,12 @@ class GenerationEngine(ReadinessMixin):
                         free.pop(0)
                 if any(r is not None for r in self._slots):
                     self._step_once()
+                elif self._held and self._prefetch_q:
+                    # Head-of-line request waiting on a host-tier
+                    # prefetch with nothing decoding: the staged copy
+                    # lands at the next iteration's top, then admission
+                    # retries. Not a stall — progress is the prefetch.
+                    pass
                 elif self._held:
                     # Starved with nothing in flight: the submit-time
                     # pool-size check makes this unreachable (every block
@@ -1093,22 +1248,115 @@ class GenerationEngine(ReadinessMixin):
     def _paged_reserve(self, req: _GenRequest):
         """Reserve the blocks ``req`` needs: prefix-registry hits are
         retained (shared), the rest freshly allocated — or None when the
-        pool can't cover it yet. Re-resolves hits after every reclaim
-        sweep (an eviction can take chain entries the first lookup
-        matched)."""
+        pool can't cover it yet, or ``"wait"`` when the chain continues
+        in the host tier under ``host_admission="wait"`` (the request
+        holds the FIFO head while the kicked prefetch lands).
+        Re-resolves hits after every reclaim sweep (an eviction can take
+        chain entries the first lookup matched). Before hard-evicting
+        registered prefixes, cold ones are OFFLOADED to the host tier
+        (when configured) so a later admission can prefetch them back
+        instead of recomputing."""
         n_total = self._blocks_needed(req.tokens.size, req.max_new)
         while True:
             hits = (self._blocks.lookup_prefix(req.tokens,
                                                salt=req.prefix_salt)
                     if self._cfg.prefix_reuse else [])
             hits = hits[:n_total]
+            if self._host_cap:
+                cont = self._blocks.host_lookup(
+                    req.tokens, len(hits), salt=req.prefix_salt)
+                if cont:
+                    self._stage_prefetch(cont)
+                    if self._cfg.host_admission == "wait":
+                        return "wait"
+                    # "miss": admit now on device-tier hits only — the
+                    # suffix recomputes; the prefetch still lands for
+                    # the NEXT admission. Never a stale read either way.
+            if self._chunked:
+                # A hit depth must be whole CHUNKS: the scan's cold and
+                # hit programs share trip boundaries only at multiples
+                # of the chunk, and at least one prompt token must
+                # remain in the suffix to score the sampled row.
+                cb = self._cfg.chunk_blocks
+                cap = ((int(req.tokens.size) - 1)
+                       // self._cfg.chunk_tokens) * cb
+                n_hit = min(len(hits), cap)
+                hits = hits[:n_hit - n_hit % cb]
             need = n_total - len(hits)
-            if self._blocks.free_count >= need:
+            free = self._blocks.free_count
+            if free >= need:
                 self._blocks.retain(hits)
                 fresh = self._blocks.alloc(need)
                 return hits, fresh, n_total
+            if self._host_cap and self._offload_for(need - free):
+                continue
             if not self._blocks.reclaim(need):
                 return None
+
+    # -- host tier (offload / prefetch) ------------------------------------
+
+    def _offload_for(self, shortfall: int) -> bool:
+        """Move up to ``shortfall`` cold registered-prefix blocks to the
+        host tier (device bytes snapshotted to host numpy staging, then
+        committed — the manager re-validates under its lock, so a hit
+        landing mid-copy cancels that block's offload). Returns whether
+        any device block was freed."""
+        # Per-block gathers with a SCALAR index: one compiled program
+        # reused for every offload. A batched fancy-index gather would
+        # recompile for each distinct victim-set size.
+        moved = 0
+        for key, blk in self._blocks.offload_candidates(shortfall):
+            payload = {"k": np.asarray(self._cache["k"][:, blk]),
+                       "v": np.asarray(self._cache["v"][:, blk])}
+            if self._blocks.offload_commit(key, payload):
+                moved += 1
+        if moved:
+            self._metrics.on_kv_offload(moved)
+        return moved > 0
+
+    def _stage_prefetch(self, cont) -> None:
+        """Queue host→device copies for a chain continuation found in
+        the host tier; applied at the next loop top, never inside a
+        decode step. Idempotent per key while a copy is in flight."""
+        now = time.monotonic()
+        for key, payload in cont:
+            if key in self._prefetch_inflight:
+                continue
+            self._prefetch_inflight.add(key)
+            self._prefetch_q.append((key, payload, now))
+
+    def _apply_prefetches(self) -> None:
+        """Land staged prefetches: allocate a device block, write the
+        staged bytes, promote the registry entry (idempotent against an
+        admission that re-registered the chain cold meanwhile — see
+        :meth:`BlockManager.promote`). Entries that cannot get a device
+        block yet stay queued for the next iteration; the loop never
+        blocks here. Writes use a SCALAR block index so the scatter
+        compiles once and is reused for every prefetch."""
+        for _ in range(len(self._prefetch_q)):
+            key, payload, t0 = self._prefetch_q.popleft()
+            if (self._blocks.free_count < 1
+                    and not self._offload_for(1)
+                    and not self._blocks.reclaim(1)):
+                # Evict by OFFLOAD first: landing one chain by
+                # destroying another turns the host tier's preservation
+                # into mutual eviction under rotation.
+                self._prefetch_q.append((key, payload, t0))
+                continue
+            try:
+                blk = self._blocks.alloc(1)[0]
+            except RuntimeError:
+                self._prefetch_q.append((key, payload, t0))
+                continue
+            k = self._cache["k"].at[:, blk].set(
+                jnp.asarray(payload["k"], self._cache["k"].dtype))
+            v = self._cache["v"].at[:, blk].set(
+                jnp.asarray(payload["v"], self._cache["v"].dtype))
+            self._cache = {"k": k, "v": v,
+                           "lengths": self._cache["lengths"]}
+            self._blocks.promote(key, blk)
+            self._prefetch_inflight.discard(key)
+            self._metrics.on_kv_prefetch(time.monotonic() - t0)
 
     def _admit(self, req: _GenRequest, slot: int) -> str:
         """Prefill ``req`` into ``slot`` and emit its first token.
@@ -1130,41 +1378,84 @@ class GenerationEngine(ReadinessMixin):
         read_row = None
         if self._paged:
             reservation = self._paged_reserve(req)
-            if reservation is None:
+            if not isinstance(reservation, tuple):
+                # None = block-starved, "wait" = host-tier chain still
+                # prefetching; either way the request holds the FIFO
+                # head and the slot stays free.
                 return "starved"
         req.t_admit = now
         self._streams_started += 1     # the serve_hook @stream counter
         try:
             length = int(req.tokens.size)
-            bucket = bucket_for(length, self._buckets)
-            toks = np.zeros((bucket,), np.int32)
-            toks[:length] = req.tokens
-            exe = self._compile(("prefill", bucket))
             args = [self._params]
             if self._adapters is not None:
                 # The table read HERE is the hot-load boundary: a load
                 # committed before this admission is visible, one racing
                 # it lands at the next boundary — never mid-program.
                 args.append(self._adapters.table())
-            args += [toks, self._cache, np.asarray(slot, np.int32),
-                     np.asarray(length, np.int32)]
-            if self._adapters is not None:
-                args.append(np.asarray(req.adapter_slot, np.int32))
-            if self._paged:
+            if self._chunked:
                 hits, fresh, n_total = reservation
                 row = hits + fresh
+                bs = self._cfg.block_size
+                ct = self._cfg.chunk_tokens
+                # The compiled program starts at the first non-shared
+                # block: the bucket is drawn on the SUFFIX length, so a
+                # deep hit executes a genuinely smaller program.
+                start = len(hits) * bs
+                suf_len = length - start
+                bucket = bucket_for(suf_len, self._chunked_buckets)
+                toks = np.zeros((bucket,), np.int32)
+                toks[:suf_len] = req.tokens[start:]
+                exe = self._compile(("chunked_prefill", bucket))
+                args += [toks, self._cache, np.asarray(slot, np.int32),
+                         np.asarray(length, np.int32),
+                         np.asarray(start, np.int32)]
+                if self._adapters is not None:
+                    args.append(np.asarray(req.adapter_slot, np.int32))
                 nb = self._cfg.blocks_per_slot
                 read_row = np.full((nb,), TRASH_BLOCK, np.int32)
                 read_row[:n_total] = row
-                # Writes aimed at SHARED prefix blocks go to the trash
-                # block: the recomputed prefix K/V is already resident,
-                # and a sharer must never touch bytes other streams read.
-                write_row = read_row.copy()
-                write_row[:len(hits)] = TRASH_BLOCK
-                n_full = length // self._cfg.block_size
-                if self._cfg.prefix_reuse and n_full > 0:
+                # Per-chunk write targets: only the fresh blocks the
+                # suffix's PROMPT positions land in — hit blocks are
+                # never written at all, generation blocks and bucket
+                # padding write to the trash block.
+                suffix_blocks = row[len(hits):blocks_for(length, bs)]
+                wflat = np.full((bucket // bs,), TRASH_BLOCK, np.int32)
+                wflat[:len(suffix_blocks)] = suffix_blocks
+                args += [wflat.reshape(bucket // ct,
+                                       self._cfg.chunk_blocks),
+                         read_row]
+                n_full = length // bs
+                if n_full > 0:
                     self._metrics.on_prefix(len(hits), n_full)
-                args.append(write_row)
+                self._metrics.on_chunked_prefill(bucket // ct,
+                                                 start // ct)
+            else:
+                bucket = bucket_for(length, self._buckets)
+                toks = np.zeros((bucket,), np.int32)
+                toks[:length] = req.tokens
+                exe = self._compile(("prefill", bucket))
+                args += [toks, self._cache, np.asarray(slot, np.int32),
+                         np.asarray(length, np.int32)]
+                if self._adapters is not None:
+                    args.append(np.asarray(req.adapter_slot, np.int32))
+                if self._paged:
+                    hits, fresh, n_total = reservation
+                    row = hits + fresh
+                    nb = self._cfg.blocks_per_slot
+                    read_row = np.full((nb,), TRASH_BLOCK, np.int32)
+                    read_row[:n_total] = row
+                    # Writes aimed at SHARED prefix blocks go to the
+                    # trash block: the recomputed prefix K/V is already
+                    # resident, and a sharer must never touch bytes
+                    # other streams read.
+                    write_row = read_row.copy()
+                    write_row[:len(hits)] = TRASH_BLOCK
+                    n_full = length // self._cfg.block_size
+                    if self._cfg.prefix_reuse and n_full > 0:
+                        self._metrics.on_prefix(len(hits), n_full)
+                    args.append(write_row)
+            self._last_prefill_bucket = bucket
             cache, last_logits = exe(*args)
             logits = np.asarray(last_logits)    # blocks
         except Exception as e:  # noqa: BLE001
@@ -1181,8 +1472,10 @@ class GenerationEngine(ReadinessMixin):
             # survives its first token.
             n_full = int(req.tokens.size) // self._cfg.block_size
             if n_full > 0:
-                self._blocks.register_prefix(req.tokens, row, n_full,
-                                             salt=req.prefix_salt)
+                self._blocks.register_prefix(
+                    req.tokens, row, n_full, salt=req.prefix_salt,
+                    route_digest=prefix_route_digest(
+                        req.tokens, self._cfg.block_size, req.adapter))
         req.t_first = time.monotonic()
         self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3,
                                      tenant=self._tenant_label(req))

@@ -172,6 +172,18 @@ class ServeMetrics:
         self._h_spec_verify = self.registry.histogram(
             "hvd_spec_verify_seconds",
             "Verify-forward execution time per spec step")
+        # KV memory-hierarchy plane (chunked prefill + host tier):
+        # offload/prefetch counters track block traffic between the
+        # device pool and pinned host memory; chunk counters pin the
+        # skip-compute contract (skipped/total = the prefill compute the
+        # prefix cache actually saved). Zero for non-tiered engines.
+        self.kv_offload_blocks_total = 0
+        self.kv_prefetch_blocks_total = 0
+        self.prefill_chunks_total = 0
+        self.prefill_chunks_skipped_total = 0
+        self._h_prefetch = self.registry.histogram(
+            "hvd_kv_prefetch_seconds",
+            "Host-to-device prefetch latency per block chain")
         # Per-tenant recorders (multi-tenant adapters): lazily created on
         # first tenant-stamped event. Engines without an AdapterRegistry
         # never stamp one (GenerationEngine._tenant_label), so base-only
@@ -288,6 +300,27 @@ class ServeMetrics:
                 self.prefix_misses_total += 1
             self.prefix_hit_blocks_total += hit_blocks
             self.prefix_lookup_blocks_total += prompt_blocks
+
+    def on_kv_offload(self, n: int = 1) -> None:
+        """``n`` cold registered-prefix blocks moved device -> host."""
+        with self._lock:
+            self.kv_offload_blocks_total += n
+
+    def on_kv_prefetch(self, seconds: float, n: int = 1) -> None:
+        """``n`` blocks landed host -> device; ``seconds`` is the
+        stage-to-landing latency of the chain (admission kicked the
+        fetch, the engine-loop top applied it — never a decode step)."""
+        with self._lock:
+            self.kv_prefetch_blocks_total += n
+        self._h_prefetch.observe(seconds)
+
+    def on_chunked_prefill(self, n_chunks: int, n_skipped: int) -> None:
+        """One chunked prefill: the compiled program ran ``n_chunks``
+        scan trips and the prefix cache let it skip ``n_skipped`` more
+        (the shared prefix it never recomputed)."""
+        with self._lock:
+            self.prefill_chunks_total += n_chunks
+            self.prefill_chunks_skipped_total += n_skipped
 
     def retry_after_ms(self, queue_depth: int) -> float:
         """Backoff hint for an overload rejection: roughly how long
@@ -415,6 +448,12 @@ class ServeMetrics:
                     "prefix_hit_blocks_total": self.prefix_hit_blocks_total,
                     "prefix_lookup_blocks_total":
                         self.prefix_lookup_blocks_total,
+                    "kv_offload_blocks_total": self.kv_offload_blocks_total,
+                    "kv_prefetch_blocks_total":
+                        self.kv_prefetch_blocks_total,
+                    "prefill_chunks_total": self.prefill_chunks_total,
+                    "prefill_chunks_skipped_total":
+                        self.prefill_chunks_skipped_total,
                     "ttft_p50": self._ttft_ms.quantile(0.50),
                     "ttft_p99": self._ttft_ms.quantile(0.99),
                     "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
@@ -527,6 +566,16 @@ _GENERATION = {
     "prefix_lookup_blocks_total": ("hvd_prefix_lookup_blocks_total",
                                    "counter",
                                    "Prompt blocks looked up"),
+    "kv_offload_blocks_total": ("hvd_kv_offload_blocks_total", "counter",
+                                "KV blocks offloaded device -> host"),
+    "kv_prefetch_blocks_total": ("hvd_kv_prefetch_blocks_total", "counter",
+                                 "KV blocks prefetched host -> device"),
+    "prefill_chunks_total": ("hvd_prefill_chunks_total", "counter",
+                             "Prefill scan chunks executed"),
+    "prefill_chunks_skipped_total": ("hvd_prefill_chunks_skipped_total",
+                                     "counter",
+                                     "Prefill scan chunks skipped via "
+                                     "prefix hits"),
 }
 
 _SPEC = {
@@ -614,6 +663,9 @@ class FleetMetrics:
         # residency report, the counter on the first adapter dispatch).
         self._g_adapters = None
         self._c_adapter_dispatch = None
+        # Prefix-affinity counter, same lazy rule: registers on the
+        # first dispatch that carried a routable prefix digest.
+        self._c_prefix_dispatch = None
         # Subprocess-replica gauge, LAZY too: a thread-only fleet never
         # exposes it (registers on the first nonzero count).
         self._g_procs = None
@@ -710,6 +762,32 @@ class FleetMetrics:
         return {o: int(self._c_adapter_dispatch.labels(outcome=o).value)
                 for o in ("affine", "miss")}
 
+    def on_prefix_dispatch(self, outcome: str) -> None:
+        """One dispatch whose request carried a routable prefix digest:
+        ``hvd_fleet_prefix_dispatch_total{outcome=}`` — ``affine`` (the
+        chosen replica advertised the digest in its registry) vs
+        ``miss`` (it will prefill the prefix cold). A rising miss share
+        means prefix-affine routing is losing to load skew, and shared
+        prompts are being recomputed across the fleet."""
+        if outcome not in ("affine", "miss"):
+            raise ValueError(
+                f"prefix dispatch outcome must be 'affine' or 'miss', "
+                f"got {outcome!r}")
+        if self._c_prefix_dispatch is None:
+            self._c_prefix_dispatch = self.registry.counter(
+                "hvd_fleet_prefix_dispatch_total",
+                "Prefix-carrying dispatches by affinity outcome",
+                labels=("outcome",))
+            for o in ("affine", "miss"):
+                self._c_prefix_dispatch.labels(outcome=o)
+        self._c_prefix_dispatch.labels(outcome=outcome).inc()
+
+    def prefix_dispatch_counts(self) -> Dict[str, int]:
+        if self._c_prefix_dispatch is None:
+            return {}
+        return {o: int(self._c_prefix_dispatch.labels(outcome=o).value)
+                for o in ("affine", "miss")}
+
     def on_stranded(self, n: int = 1) -> None:
         """``n`` streams were stranded by a replica death/abort."""
         self._c_stranded.inc(n)
@@ -779,7 +857,25 @@ def collect_stats(snap: Dict, registry: MetricsRegistry,
     _emit(_TOP, snap)
     _emit(_GENERATION, snap.get("generation") or {})
     _emit(_SPEC, snap.get("spec") or {})
-    _emit(_BLOCKS, snap.get("blocks") or {})
+    blocks_src = snap.get("blocks") or {}
+    _emit(_BLOCKS, blocks_src)
+    # Tier-labeled split of the same block gauges: the unlabeled series
+    # above stay the device pool (the pinned legacy meaning); tier=
+    # samples account for EVERY block across the memory hierarchy, so
+    # device + host sums match the configured capacities exactly.
+    for short, (tiers) in (("total", ("total", "host_total")),
+                           ("free", ("free", "host_free")),
+                           ("used", ("used", "host_used"))):
+        dev_key, host_key = tiers
+        if host_key not in blocks_src:
+            continue
+        name, _typ, _help = _BLOCKS[dev_key]
+        for tier, key in (("device", dev_key), ("host", host_key)):
+            v = blocks_src.get(key)
+            if v is None or isinstance(v, bool) or not isinstance(
+                    v, (int, float)):
+                continue
+            samples.append((name, {**labels, "tier": tier}, float(v)))
     meta["hvd_rejected_total"] = (
         "counter", "Door rejections split by the scarce resource")
     for reason_key, reason in (("rejected_slots_full", "slots_full"),

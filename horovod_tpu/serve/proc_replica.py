@@ -119,6 +119,7 @@ class ProcReplicaClient:
                  backoff_s: float = 0.05,
                  backoff_cap_s: float = 0.5,
                  ready_timeout_s: float = 180.0,
+                 heartbeat_timeout_s: float = 5.0,
                  default_deadline_ms: Optional[float] = None):
         self.name = name
         self.serve_name = name          # router re-stamps on _attach
@@ -133,6 +134,8 @@ class ProcReplicaClient:
         self._backoff = backoff_s
         self._backoff_cap = backoff_cap_s
         self._ready_timeout = ready_timeout_s
+        self._hb_file = (ready_file + ".hb") if ready_file else None
+        self._hb_timeout = heartbeat_timeout_s
         self._cfg = _ClientCfg(default_deadline_ms)
         self._closed = False            # router reads this as "draining"
         self._suspect = False
@@ -256,6 +259,27 @@ class ProcReplicaClient:
         names = self.adapter_names()
         return None if names is None else len(names)
 
+    def prefix_digests(self) -> Tuple[str, ...]:
+        """Registered-prefix route digests from the child's ``/stats``
+        — the surface the router's prefix-affine dispatch reads (empty
+        = nothing registered, never affine). Served from the stats
+        cache (``load()`` refreshes it every dispatch walk); one fresh
+        fetch when nothing is cached yet."""
+        snap = self._last_stats
+        if not snap:
+            snap = self.stats()
+        digests = snap.get("prefix_digests")
+        if not isinstance(digests, (list, tuple)):
+            return ()
+        return tuple(str(d) for d in digests)
+
+    @property
+    def route_block_size(self) -> Optional[int]:
+        """The child's KV block size (the digest granularity), from the
+        same cached ``/stats`` snapshot ``prefix_digests`` reads."""
+        bs = self._last_stats.get("block_size")
+        return bs if isinstance(bs, int) and bs > 0 else None
+
     def _active_rows(self) -> int:
         """Best-effort active-slot count for the router's fleet peak
         sampling — read from the stats cache (a fresh HTTP fetch per
@@ -307,6 +331,33 @@ class ProcReplicaClient:
         self._miss_streak = 0
         self._suspect = False
         return True
+
+    def _heartbeat_stale(self) -> bool:
+        """True once the worker's heartbeat file has gone silent past
+        the timeout. A missing file reads FRESH, not stale — the child
+        may still be booting (warmup gates traffic either way), and an
+        operator pointing at a worker predating the heartbeat plane
+        must not have every replica read dead."""
+        if self._hb_file is None:
+            return False
+        try:
+            age = time.time() - os.path.getmtime(self._hb_file)
+        except OSError:
+            return False
+        return age > self._hb_timeout
+
+    def aborted(self) -> bool:
+        """The ``CoordClient.aborted`` surface, so a subprocess replica
+        wires onto the existing :func:`~.fleet.heartbeat_liveness` hook
+        unchanged: gone once the child process exited, its heartbeat
+        file went stale, or the ``/healthz`` probe's two-strike verdict
+        fired (the probe still runs — the heartbeat catches a SIGSTOPed
+        or wedged-before-accept child the HTTP path answers for)."""
+        if self._proc is not None and self._proc.poll() is not None:
+            return True
+        if self._heartbeat_stale():
+            return True
+        return not self.loop_alive()
 
     # -- engine surface: submit / generate ----------------------------------
 
@@ -584,12 +635,30 @@ def spawn_replica_factory(spec: Dict[str, Any], *,
                "--spec", spec_path, "--ready-file", ready_path,
                "--parent-pid", str(os.getpid())]
         proc = subprocess.Popen(cmd, stdin=subprocess.PIPE)
-        return ProcReplicaClient(
+        client = ProcReplicaClient(
             name, proc, host=child_spec["host"], ready_file=ready_path,
             ready_timeout_s=ready_timeout_s,
             default_deadline_ms=(child_spec.get("generation")
                                  or {}).get("default_deadline_ms"), **kw)
+        factory.clients[name] = client
+        return client
 
+    # Liveness wiring for FleetRouter(liveness_factory=...): each
+    # spawned client implements the CoordClient ``aborted`` surface
+    # (pid + heartbeat file + /healthz two-strike), so the existing
+    # heartbeat_liveness adapter consumes it unchanged. Names this
+    # factory never minted (thread replicas attached by hand) get None
+    # — the handle falls back to its default in-process probe.
+    factory.clients = {}
+
+    def liveness_factory(name: str):
+        client = factory.clients.get(name)
+        if client is None:
+            return None
+        from .fleet import heartbeat_liveness
+        return heartbeat_liveness(client)
+
+    factory.liveness_factory = liveness_factory
     return factory
 
 
@@ -616,6 +685,28 @@ def _arm_parent_watchdog(parent_pid: int, engine_ref: list,
             time.sleep(poll_s)
     threading.Thread(target=_watch, daemon=True,
                      name="hvd-proc-parent-watchdog").start()
+
+
+def _arm_heartbeat(hb_file: str, period_s: float = 1.0) -> None:
+    """The worker's liveness beat: rewrite ``hb_file`` every
+    ``period_s`` (atomic tmp + replace — the parent keys staleness on
+    the file's mtime, so a torn write must be impossible). A SIGKILLed
+    or SIGSTOPed worker stops beating and the parent's
+    :meth:`ProcReplicaClient.aborted` verdict flips within the
+    heartbeat timeout — the same silence-means-dead contract the coord
+    plane's heartbeats keep."""
+    def _beat():
+        while True:
+            try:
+                tmp = hb_file + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"ts": time.time(), "pid": os.getpid()}, f)
+                os.replace(tmp, hb_file)
+            except OSError:
+                pass        # a full disk must not kill the worker
+            time.sleep(period_s)
+    threading.Thread(target=_beat, daemon=True,
+                     name="hvd-proc-heartbeat").start()
 
 
 def _resolve_dtype(jnp, name):
@@ -735,6 +826,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     with open(tmp, "w") as f:
         json.dump(ready, f)
     os.replace(tmp, args.ready_file)    # atomic: no torn ready read
+    _arm_heartbeat(args.ready_file + ".hb")
     print(f"[proc_replica] {name}: ready on {srv.host}:{srv.port} "
           f"(pid {os.getpid()})", flush=True)
 

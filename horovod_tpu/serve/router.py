@@ -83,6 +83,7 @@ from ..exceptions import (DeadlineExceededError, FailoverExhaustedError,
                           ReplicaTimeoutError, ServerClosedError,
                           ServerOverloadedError, WorkerFailureError)
 from ..obs import flightrec
+from ..parallel.kv_blocks import prefix_route_digest
 from .generate import GenerationHandle
 from .metrics import FleetMetrics
 
@@ -654,6 +655,51 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 — a dying replica reads empty
             return ()
 
+    @staticmethod
+    def _resident_digests(handle: ReplicaHandle) -> frozenset:
+        """A replica's advertised registered-prefix route digests
+        (empty for engines without a prefix registry — they can never
+        serve a prefix hit, so they never sort as prefix-affine)."""
+        fn = getattr(handle.engine, "prefix_digests", None)
+        if not callable(fn):
+            return frozenset()
+        try:
+            return frozenset(fn() or ())
+        except Exception:  # noqa: BLE001 — a dying replica reads empty
+            return frozenset()
+
+    def _prefix_affinity(self, ready: List[ReplicaHandle], tokens,
+                         adapter: Optional[str]) -> Dict[str, bool]:
+        """Which ready replicas already hold this prompt's first-block
+        prefix (``{name: affine}``; a name is present only when routing
+        was actually in play — the replica advertised digests AND the
+        prompt had a routable first block at that replica's block size).
+        Purely advisory: a stale digest costs one cache miss downstream,
+        never a wrong byte, so errors and absences all read as
+        non-affine."""
+        affine: Dict[str, bool] = {}
+        if tokens is None:
+            return affine
+        digest_cache: Dict[int, Optional[str]] = {}
+        for h in ready:
+            digests = self._resident_digests(h)
+            if not digests:
+                continue
+            bs = getattr(h.engine, "route_block_size", None)
+            if not isinstance(bs, int) or bs <= 0:
+                continue
+            if bs not in digest_cache:
+                try:
+                    digest_cache[bs] = prefix_route_digest(
+                        tokens, bs, adapter)
+                except Exception:  # noqa: BLE001 — advisory only
+                    digest_cache[bs] = None
+            d = digest_cache[bs]
+            if d is None:
+                continue
+            affine[h.name] = d in digests
+        return affine
+
     def _lazy_load(self, handle: ReplicaHandle, adapter: str) -> None:
         """The affinity-miss path: fetch the adapter from
         ``adapter_source`` and hot-load it into ``handle`` before the
@@ -738,16 +784,26 @@ class FleetRouter:
         (a failover replay tries every OTHER door first, but a fleet
         whose only ready replica is the avoided one still gets it)."""
         adapter = kwargs.get("adapter")
+        tokens = args[0] if args else kwargs.get("tokens")
         snapshot = self.replicas()
         ready = [h for h in snapshot if h.state() == "ready"]
         resident: Dict[str, bool] = {}
+        # Prefix-affine routing: replicas already holding this prompt's
+        # registered first block sort ahead of equally-ready peers —
+        # adapter residency still outranks it (a lazy adapter load is
+        # strictly costlier than a cold prefill), load still tiebreaks.
+        affine = self._prefix_affinity(ready, tokens, adapter)
         if adapter is not None:
             resident = {h.name: adapter in self._resident_names(h)
                         for h in ready}
             ready.sort(key=lambda h: (h.name == avoid,
-                                      not resident[h.name], h.load()))
+                                      not resident[h.name],
+                                      not affine.get(h.name, False),
+                                      h.load()))
         else:
-            ready.sort(key=lambda h: (h.name == avoid, h.load()))
+            ready.sort(key=lambda h: (h.name == avoid,
+                                      not affine.get(h.name, False),
+                                      h.load()))
         if not ready:
             warming = sum(1 for h in snapshot if h.state() == "warming")
             if warming:
@@ -824,6 +880,11 @@ class FleetRouter:
             if adapter is not None:
                 self._metrics.on_adapter_dispatch(
                     "affine" if resident.get(h.name) else "miss")
+            if affine:
+                # Routing was in play (>= 1 replica advertised digests
+                # and the prompt was routable): record the outcome.
+                self._metrics.on_prefix_dispatch(
+                    "affine" if affine.get(h.name) else "miss")
             self._note_peak()
             return out, h
         if adapter is not None and hosting_error is not None \
@@ -1253,7 +1314,9 @@ class FleetRouter:
     _SUM_KEYS = _COUNTER_KEYS + _GAUGE_KEYS
     _GEN_SUM_KEYS = ("generations_total", "tokens_generated_total",
                      "prefix_hits_total", "prefix_misses_total",
-                     "prefix_hit_blocks_total", "prefix_lookup_blocks_total")
+                     "prefix_hit_blocks_total", "prefix_lookup_blocks_total",
+                     "kv_offload_blocks_total", "kv_prefetch_blocks_total",
+                     "prefill_chunks_total", "prefill_chunks_skipped_total")
     # Per-tenant counters summed across replicas (+ retired baselines —
     # same monotonicity rule); tenant percentile fields cannot be summed
     # and stay in the nested per-replica snapshots (scrape the
@@ -1370,6 +1433,7 @@ class FleetRouter:
             snap["adapters_resident"] = k
         snap["replicas"] = per
         adapter_dispatch = self._metrics.adapter_dispatch_counts()
+        prefix_dispatch = self._metrics.prefix_dispatch_counts()
         snap["fleet"] = {
             "replicas": len(per),
             "states": states,
@@ -1380,6 +1444,8 @@ class FleetRouter:
             "streams_stranded_total": self._metrics.stranded_count(),
             **({"adapter_dispatch": adapter_dispatch}
                if adapter_dispatch else {}),
+            **({"prefix_dispatch": prefix_dispatch}
+               if prefix_dispatch else {}),
         }
         return snap
 

@@ -1,0 +1,529 @@
+"""KV memory hierarchy tests: the chunked-prefill bitwise contract at
+the model layer (suffix program's logits vs the full-prompt program's),
+engine-level cold-vs-hit stream identity across the edge geometries
+(partial last shared block, suffix shorter than one block, hit chain at
+the slot's block budget with the suffix bucket overhanging max_len),
+the host tier's offload → prefetch roundtrip under real pool pressure
+(wait AND miss admission policies — an offloaded chain admits as a
+miss, never a stale read), prefix-affine fleet routing over advertised
+digests, the subprocess heartbeat-liveness plane, and the tier-labeled
+``hvd_kv_blocks_*`` exposition.
+
+All CPU and deliberately tiny (tier-1 budget): the same module-scoped
+model as tests/test_paged_kv.py; every engine compiles at most one
+decode program and two chunked-prefill buckets (8 and 16). The timed
+capacity/TTFT drills (hit-vs-cold TTFT gap, blocks_exhausted below the
+device-only run under sustained load) live in ci.sh via serve_bench —
+they are wall-clock claims, not unit contracts.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serve
+from horovod_tpu.parallel.kv_blocks import (TRASH_BLOCK, BlockManager,
+                                            init_paged_kv_cache,
+                                            paged_chunked_prefill,
+                                            prefix_route_digest)
+from horovod_tpu.parallel.transformer import TransformerConfig, init_params
+from horovod_tpu.serve.engine import ReadinessMixin
+from horovod_tpu.serve.fleet import heartbeat_liveness
+from horovod_tpu.serve.proc_replica import ProcReplicaClient
+from horovod_tpu.serve.router import FleetRouter
+from horovod_tpu.serve.spec import SpecConfig
+
+CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]   # 11 tokens; 2 full blocks @ 4
+CHAIN = PROMPT[:8]                           # exactly the registrable chain
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 16)
+    kw.setdefault("default_max_new_tokens", 4)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefix_reuse", True)
+    kw.setdefault("chunked_prefill", True)
+    spec = kw.pop("spec", None)
+    return serve.GenerationEngine(params, cfg,
+                                  serve.GenerationConfig(**kw), spec=spec)
+
+
+def _gen(eng):
+    return dict(eng.stats()["generation"])
+
+
+class TestChunkedModelLayer:
+    def test_suffix_logits_bitwise_equal_full_program(self, model):
+        """THE skip-compute contract: the suffix program (start at the
+        first non-shared block, hit K/V read from the pool via the read
+        row) produces logits BITWISE-equal to the full-prompt chunked
+        program's rows for the same positions, and writes byte-identical
+        K/V into its fresh blocks. Geometries: partial last block,
+        suffix of one token, chain at the slot budget (suffix bucket
+        overhanging the prompt), and a 2-block chunk."""
+        cfg, params = model
+        bs, max_len = 4, 16
+        for prompt_len, hit_blocks, full_b, suf_b, cb, seed in (
+                (11, 2, 16, 8, 1, 0),     # partial last shared block
+                (9, 2, 16, 8, 1, 1),      # 1-token suffix
+                (15, 3, 16, 8, 1, 3),     # budget chain, 12+8 > max_len
+                (13, 2, 16, 16, 2, 2)):   # 2-block chunks
+            C = cb * bs
+            rng = np.random.RandomState(seed)
+            prompt = rng.randint(0, cfg.vocab, (prompt_len,)).astype(np.int32)
+            max_blocks = max_len // bs
+            start = hit_blocks * bs
+            n_chain = -(-prompt_len // bs)
+            chain = list(range(1, 1 + n_chain))
+            pc = init_paged_kv_cache(cfg, 16, bs, 2)
+            row = np.zeros((max_blocks,), np.int32)
+            row[:n_chain] = chain
+            wrows = np.zeros((full_b // C, cb), np.int32)
+            wrows.reshape(-1)[:n_chain] = chain
+            toks = np.zeros((full_b,), np.int32)
+            toks[:prompt_len] = prompt
+            pc, lg_full = jax.jit(
+                lambda p, t, c, w, r: paged_chunked_prefill(
+                    p, t, c, 0, w, r, 0, cfg, length=prompt_len,
+                    chunk_blocks=cb))(params, toks, pc, wrows, row)
+            fresh = list(range(1 + n_chain,
+                               1 + n_chain + (n_chain - hit_blocks)))
+            rrow = np.zeros((max_blocks,), np.int32)
+            rrow[:hit_blocks] = chain[:hit_blocks]
+            rrow[hit_blocks:n_chain] = fresh
+            wsuf = np.zeros((suf_b // C, cb), np.int32)
+            wsuf.reshape(-1)[:len(fresh)] = fresh
+            suf_len = prompt_len - start
+            tsuf = np.zeros((suf_b,), np.int32)
+            tsuf[:suf_len] = prompt[start:]
+            pc2, lg_suf = jax.jit(
+                lambda p, t, c, w, r: paged_chunked_prefill(
+                    p, t, c, 1, w, r, start, cfg, length=prompt_len,
+                    chunk_blocks=cb))(params, tsuf, pc, wsuf, rrow)
+            np.testing.assert_array_equal(
+                np.asarray(lg_full)[start:prompt_len],
+                np.asarray(lg_suf)[:suf_len])
+            for li in range(cfg.n_layers):
+                for j, fb in enumerate(fresh):
+                    src = chain[hit_blocks + j]
+                    rows = min(bs, prompt_len - (hit_blocks + j) * bs)
+                    np.testing.assert_array_equal(
+                        np.asarray(pc["k"])[li, src, :rows],
+                        np.asarray(pc2["k"])[li, fb, :rows])
+                    np.testing.assert_array_equal(
+                        np.asarray(pc["v"])[li, src, :rows],
+                        np.asarray(pc2["v"])[li, fb, :rows])
+
+    def test_single_trip_bucket_rejected(self, model):
+        """XLA fully unrolls a 1-trip scan into a shape-specialized
+        program — the fixed-shape-body equality argument dies with it,
+        so the model layer refuses the geometry outright."""
+        cfg, params = model
+        pc = init_paged_kv_cache(cfg, 8, 4, 1)
+        with pytest.raises(ValueError, match="trip"):
+            paged_chunked_prefill(params, np.zeros((4,), np.int32), pc, 0,
+                                  np.zeros((1, 1), np.int32),
+                                  np.zeros((4,), np.int32), 0, cfg,
+                                  length=3)
+
+
+class TestChunkedConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="prefix_reuse"):
+            serve.GenerationConfig(kv_layout="paged", block_size=4,
+                                   chunked_prefill=True)
+        with pytest.raises(ValueError, match="power of two"):
+            serve.GenerationConfig(kv_layout="paged", block_size=4,
+                                   prefix_reuse=True, chunked_prefill=True,
+                                   chunk_blocks=3)
+        # max_len must leave every chunked bucket >= 2 scan trips
+        with pytest.raises(ValueError, match="chunk"):
+            serve.GenerationConfig(kv_layout="paged", block_size=4,
+                                   max_len=16, max_slots=2,
+                                   prefix_reuse=True, chunked_prefill=True,
+                                   chunk_blocks=4)
+        gc = serve.GenerationConfig(kv_layout="paged", block_size=4,
+                                    max_len=16, max_slots=2,
+                                    prefix_reuse=True, chunked_prefill=True,
+                                    chunk_blocks=2)
+        assert gc.chunk_tokens == 8
+
+
+class TestChunkedEngineGeometry:
+    """Cold-run/hit-run pairs of the SAME prompt in a fresh engine per
+    geometry: the hit admission must compile/execute the SUFFIX bucket
+    (pinned via last_prefill_bucket) and stream the cold run's exact
+    tokens."""
+
+    def _cold_hit(self, params, cfg, prompt, **kw):
+        eng = _engine(params, cfg, **kw)
+        try:
+            cold = eng.generate(prompt, timeout=60)
+            b_cold = eng.stats()["last_prefill_bucket"]
+            g0 = _gen(eng)
+            hit = eng.generate(prompt, timeout=60)
+            snap = eng.stats()
+            g1 = _gen(eng)
+            assert hit["tokens"] == cold["tokens"], (cold, hit)
+            return (b_cold, snap["last_prefill_bucket"],
+                    g1["prefill_chunks_total"] - g0["prefill_chunks_total"],
+                    g1["prefill_chunks_skipped_total"]
+                    - g0["prefill_chunks_skipped_total"],
+                    g1["prefix_hits_total"] - g0["prefix_hits_total"])
+        finally:
+            eng.shutdown()
+
+    def test_partial_last_shared_block(self, model):
+        """11-token prompt, 2 registered blocks: the hit skips both full
+        chunks and re-prefills only the 3-token partial tail — suffix
+        bucket 8, not the cold run's 16."""
+        cfg, params = model
+        b_cold, b_hit, chunks, skipped, hits = self._cold_hit(
+            params, cfg, PROMPT)
+        assert (b_cold, b_hit) == (16, 8)
+        assert (chunks, skipped, hits) == (2, 2, 1)
+
+    def test_prompt_equals_chain_keeps_one_suffix_token(self, model):
+        """A prompt that IS the registered chain: the hit cap must hold
+        back one chunk so at least one prompt token remains in the
+        suffix to score the sampled row — never a zero-length suffix
+        program."""
+        cfg, params = model
+        b_cold, b_hit, chunks, skipped, hits = self._cold_hit(
+            params, cfg, CHAIN)
+        assert (b_cold, b_hit) == (8, 8)
+        assert (chunks, skipped) == (2, 1)     # one chunk held back
+
+    def test_suffix_shorter_than_one_block(self, model):
+        """Chain + a single token: the suffix is 1 token, still drawn
+        from the smallest >=2-trip bucket."""
+        cfg, params = model
+        b_cold, b_hit, chunks, skipped, hits = self._cold_hit(
+            params, cfg, CHAIN + [7])
+        assert (b_cold, b_hit) == (16, 8)
+        assert (chunks, skipped) == (2, 2)
+
+    def test_hit_chain_at_slot_budget(self, model):
+        """15-token prompt with max_new=1: the 3-block hit chain plus
+        one fresh block fills the slot budget exactly, and the suffix
+        bucket overhangs max_len (start 12 + bucket 8 = 20 > 16) — the
+        overhang rows are masked padding, never a wrong byte."""
+        cfg, params = model
+        p15 = PROMPT + [7, 2, 7, 1]
+        eng = _engine(params, cfg)
+        try:
+            cold = eng.generate(p15, timeout=60, max_new_tokens=1)
+            hit = eng.generate(p15, timeout=60, max_new_tokens=1)
+            snap = eng.stats()
+            assert hit["tokens"] == cold["tokens"]
+            assert snap["last_prefill_bucket"] == 8
+            g = _gen(eng)
+            assert g["prefix_hit_blocks_total"] == 3
+        finally:
+            eng.shutdown()
+
+    def test_seeded_sampling_digest_identical(self, model):
+        cfg, params = model
+        eng = _engine(params, cfg)
+        samp = serve.SamplingParams(temperature=0.7, top_k=8, seed=11)
+        try:
+            cold = eng.generate(PROMPT, timeout=60, sampling=samp)
+            hit = eng.generate(PROMPT, timeout=60, sampling=samp)
+            assert hit["tokens"] == cold["tokens"]
+            assert eng.stats()["last_prefill_bucket"] == 8
+        finally:
+            eng.shutdown()
+
+    def test_spec_on_matches_spec_off(self, model):
+        """Speculation composes with chunked prefill: greedy streams are
+        identical spec-on vs spec-off, cold AND hit."""
+        cfg, params = model
+        plain = _engine(params, cfg)
+        spec = _engine(params, cfg, spec=SpecConfig(k=2))
+        try:
+            for eng in (plain, spec):       # cold then hit in each
+                eng.generate(PROMPT, timeout=60)
+            p_hit = plain.generate(PROMPT, timeout=60)
+            s_hit = spec.generate(PROMPT, timeout=60)
+            assert p_hit["tokens"] == s_hit["tokens"]
+            assert spec.stats()["last_prefill_bucket"] == 8
+        finally:
+            plain.shutdown()
+            spec.shutdown()
+
+
+class TestHostTier:
+    def test_offload_prefetch_roundtrip_and_registry_survival(self, model):
+        """Pool pressure offloads the cold registered chain to host
+        instead of dropping it; the next shared admission prefetches it
+        back and streams the cold run's exact tokens. The device-only
+        engine under the SAME pressure loses the chain (the re-run is a
+        miss) — the registry-capacity raise the host tier buys. Tier
+        gauges account for every block on both sides of the roundtrip."""
+        cfg, params = model
+        pressure = ([7 + (i % 20) for i in range(12)],
+                    [11 + (i % 17) for i in range(12)])
+        tiered = _engine(params, cfg, max_slots=1, n_blocks=8,
+                         host_blocks=8)
+        device = _engine(params, cfg, max_slots=1, n_blocks=8)
+        try:
+            cold = tiered.generate(PROMPT, timeout=60)
+            device.generate(PROMPT, timeout=60)
+            for p in pressure:              # force free < need at admit
+                tiered.generate(p, timeout=60)
+                device.generate(p, timeout=60)
+            snap = tiered.stats()
+            assert snap["generation"]["kv_offload_blocks_total"] > 0
+            assert snap["blocks"]["host_used"] > 0
+            assert (snap["blocks"]["host_used"] + snap["blocks"]["host_free"]
+                    == snap["blocks"]["host_total"])
+            g0t, g0d = _gen(tiered), _gen(device)
+            hit = tiered.generate(PROMPT, timeout=60)
+            device_re = device.generate(PROMPT, timeout=60)
+            assert hit["tokens"] == cold["tokens"]
+            assert device_re["tokens"] == cold["tokens"]
+            g1t, g1d = _gen(tiered), _gen(device)
+            # host tier: chain survived as a (prefetched) hit; device
+            # only: the pressure evicted it — a full-recompute miss
+            assert g1t["kv_prefetch_blocks_total"] > 0
+            assert (g1t["prefix_hits_total"]
+                    - g0t["prefix_hits_total"]) == 1
+            assert (g1d["prefix_misses_total"]
+                    - g0d["prefix_misses_total"]) == 1
+            snap = tiered.stats()
+            assert (snap["blocks"]["free"] + snap["blocks"]["used"]
+                    == snap["blocks"]["total"])
+        finally:
+            tiered.shutdown()
+            device.shutdown()
+
+    def test_miss_policy_admits_without_waiting_never_stale(self, model):
+        """host_admission="miss" (the eviction-racing-admission edge):
+        an admission whose chain sits in the host tier does NOT wait —
+        it recomputes the suffix from the device hits it has (here:
+        none), streaming the cold tokens exactly. The kicked prefetch
+        still lands, so the NEXT admission hits."""
+        cfg, params = model
+        eng = _engine(params, cfg, max_slots=1, n_blocks=8, host_blocks=8,
+                      host_admission="miss")
+        try:
+            cold = eng.generate(PROMPT, timeout=60)
+            for p in ([7 + (i % 20) for i in range(12)],
+                      [11 + (i % 17) for i in range(12)]):
+                eng.generate(p, timeout=60)
+            assert _gen(eng)["kv_offload_blocks_total"] > 0
+            g0 = _gen(eng)
+            racing = eng.generate(PROMPT, timeout=60)   # chain on host
+            assert racing["tokens"] == cold["tokens"]
+            g1 = _gen(eng)
+            assert (g1["prefix_misses_total"]
+                    - g0["prefix_misses_total"]) == 1   # admitted as miss
+            again = eng.generate(PROMPT, timeout=60)    # prefetch landed
+            assert again["tokens"] == cold["tokens"]
+            g2 = _gen(eng)
+            assert (g2["prefix_hits_total"] - g1["prefix_hits_total"]) == 1
+        finally:
+            eng.shutdown()
+
+    def test_block_manager_host_accounting(self):
+        """Manager-level tier accounting: offload is two-phase (a hit
+        landing mid-copy cancels the commit), promote moves the
+        allocation back, register pops the host copy, and the gauges
+        cover every block in both tiers at every step."""
+        bm = BlockManager(6, 4, host_blocks=4)
+        toks = np.arange(8, dtype=np.int32)
+        blocks = bm.alloc(2)
+        bm.register_prefix(toks, blocks, 2,
+                           route_digest=prefix_route_digest(toks, 4))
+        bm.release(blocks)
+        cands = bm.offload_candidates(2)
+        assert len(cands) == 2
+        for key, blk in cands:
+            assert bm.offload_commit(key, {"blk": blk})
+        g = bm.gauges()
+        assert g["host_used"] == 2 and g["free"] == 5
+        assert bm.lookup_prefix(toks) == []             # device side empty
+        cont = bm.host_lookup(toks, 0)
+        assert len(cont) == 2
+        # promote the first back; the chain continues host-side
+        key0, payload0 = cont[0]
+        blk = bm.alloc(1)[0]
+        bm.promote(key0, blk)
+        assert bm.lookup_prefix(toks) == [blk]
+        assert bm.host_lookup(toks, 1)                  # j=1 still on host
+        g = bm.gauges()
+        assert g["host_used"] == 1
+        assert g["free"] + g["used"] == g["total"]
+        assert bm.route_digests() == (prefix_route_digest(toks, 4),)
+        # a re-register of the same chain pops the host leftovers
+        fresh = bm.alloc(2)
+        bm.register_prefix(toks, [blk] + fresh[:1], 2)
+        assert bm.gauges()["host_used"] == 0
+
+
+class _PrefixFake(ReadinessMixin):
+    """Router-contract fake advertising a registered-prefix digest set
+    (the `/stats` surface ProcReplicaClient mirrors)."""
+
+    def __init__(self, digests=(), bs=4, load=0, warmed=True):
+        self._queue = []
+        self._warmed = warmed
+        self._closed = False
+        self._load = load
+        self._digests = tuple(digests)
+        self.route_block_size = bs
+        self.submits = []
+
+    def load(self):
+        return self._load
+
+    def prefix_digests(self):
+        return self._digests
+
+    def submit(self, *a, **kw):
+        self.submits.append((a, kw))
+        return "accepted"
+
+    def warmup(self):
+        self._warmed = True
+
+    def shutdown(self, drain=True, timeout=None):
+        self._closed = True
+
+    def stats(self):
+        return {"requests_total": len(self.submits), "queue_depth": 0}
+
+    def prom_collect(self):
+        return ({}, [])
+
+
+class TestPrefixAffineRouting:
+    def test_affine_replica_outranks_load(self):
+        toks = np.arange(8, dtype=np.int32)
+        d = prefix_route_digest(toks, 4)
+        warm = _PrefixFake(digests=(d,), load=9)
+        cold = _PrefixFake(load=0)
+        router = FleetRouter(engines=[warm, cold])
+        router.submit(toks)
+        # r0 advertises the prompt's first-block digest: it wins the
+        # dispatch despite carrying 9x the load.
+        assert warm.submits and not cold.submits
+        assert router._metrics.prefix_dispatch_counts() == {
+            "affine": 1, "miss": 0}
+        assert router.stats()["fleet"]["prefix_dispatch"] == {
+            "affine": 1, "miss": 0}
+
+    def test_non_matching_digest_counts_a_miss(self):
+        toks = np.arange(8, dtype=np.int32)
+        other = prefix_route_digest(np.arange(8, 16, dtype=np.int32), 4)
+        adv = _PrefixFake(digests=(other,), load=5)
+        lo = _PrefixFake(load=0)
+        router = FleetRouter(engines=[adv, lo])
+        router.submit(toks)
+        assert lo.submits and not adv.submits
+        assert router._metrics.prefix_dispatch_counts() == {
+            "affine": 0, "miss": 1}
+
+    def test_salt_framing_keeps_tenants_apart(self):
+        """The digest is framed exactly like the registry key: the same
+        tokens under a different adapter hash differently, so affinity
+        can never alias across tenants."""
+        toks = np.arange(8, dtype=np.int32)
+        assert (prefix_route_digest(toks, 4)
+                != prefix_route_digest(toks, 4, adapter="t1"))
+        assert (prefix_route_digest(toks, 4, adapter="t1")
+                != prefix_route_digest(toks, 4, adapter="t2"))
+        # sub-block prompts have no routable first block
+        assert prefix_route_digest(toks[:3], 4) is None
+
+    def test_unroutable_prompt_skips_the_plane(self):
+        """No digests advertised / no routable first block: dispatch is
+        plain least-load and the outcome counter never moves."""
+        adv = _PrefixFake(load=5)                  # nothing registered
+        lo = _PrefixFake(load=0)
+        router = FleetRouter(engines=[adv, lo])
+        router.submit(np.arange(8, dtype=np.int32))
+        router.submit(np.arange(2, dtype=np.int32))   # sub-block
+        assert len(lo.submits) == 2
+        assert router._metrics.prefix_dispatch_counts() == {}
+        assert "prefix_dispatch" not in router.stats()["fleet"]
+
+
+class TestHeartbeatLiveness:
+    def test_stale_heartbeat_flips_aborted(self, tmp_path):
+        ready = str(tmp_path / "r0.ready")
+        c = ProcReplicaClient("r0", None, port=1, ready_file=ready,
+                              heartbeat_timeout_s=0.5)
+        # no heartbeat file yet: booting reads FRESH, not dead
+        assert c._heartbeat_stale() is False
+        hb = ready + ".hb"
+        with open(hb, "w") as f:
+            f.write("{}")
+        assert c._heartbeat_stale() is False
+        c.loop_alive = lambda: True          # keep aborted() off HTTP
+        alive = heartbeat_liveness(c)
+        assert alive() is True
+        past = time.time() - 5.0
+        os.utime(hb, (past, past))           # the worker went silent
+        assert c._heartbeat_stale() is True
+        assert c.aborted() is True
+        assert alive() is False
+
+    def test_factory_exposes_liveness_hooks(self, tmp_path):
+        from horovod_tpu.serve.proc_replica import spawn_replica_factory
+        factory = spawn_replica_factory({"model": dict(CFG)},
+                                        run_dir=str(tmp_path))
+        assert factory.clients == {}
+        assert factory.liveness_factory("never-spawned") is None
+
+
+class TestTierExposition:
+    def test_tier_labeled_block_gauges(self, model):
+        """The exposition splits the pool by tier WITHOUT renaming the
+        pinned unlabeled series: hvd_kv_blocks_total stays (ci.sh pins
+        it), and tier="device"/"host" samples account for every block."""
+        cfg, params = model
+        eng = _engine(params, cfg, n_blocks=8, host_blocks=4)
+        try:
+            snap = eng.stats()
+            _meta, samples = eng.prom_collect()
+            by = {}
+            for name, labels, value in samples:
+                by[(name, labels.get("tier"))] = value
+            for short in ("total", "free", "used"):
+                name = f"hvd_kv_blocks_{short}"
+                assert (name, None) in by            # pinned series
+                assert (name, "device") in by and (name, "host") in by
+                assert by[(name, None)] == by[(name, "device")]
+            assert by[("hvd_kv_blocks_total", "host")] == 4
+            assert (by[("hvd_kv_blocks_used", "host")]
+                    + by[("hvd_kv_blocks_free", "host")] == 4)
+            # one valid exposition: single TYPE line per family
+            text = eng.prom_metrics()
+            assert text.count("# TYPE hvd_kv_blocks_total ") == 1
+            for counter in ("hvd_kv_offload_blocks_total",
+                            "hvd_kv_prefetch_blocks_total",
+                            "hvd_prefill_chunks_total",
+                            "hvd_prefill_chunks_skipped_total"):
+                assert f"# TYPE {counter} counter" in text
+            assert "hvd_kv_prefetch_seconds" in text
+            assert snap["chunked_prefill"] is True
+        finally:
+            eng.shutdown()
