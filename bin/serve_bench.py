@@ -217,6 +217,28 @@ def _bench_tenants(args):
     return tenants, [w / total for w in weights]
 
 
+def _parse_tenant_map(spec: str, what: str, cast):
+    """``"base:1,a0:4"`` → ``{"base": cast("1"), "a0": cast("4")}`` —
+    the shared parser behind --tenant-weights / --priority-mix /
+    --tenant-slo-ms. Raises SystemExit with a usable message (argparse
+    p.error re-raises it) on malformed pairs."""
+    out = {}
+    if not spec:
+        return out
+    for pair in spec.split(","):
+        name, sep, val = pair.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise SystemExit(
+                f"{what} must be comma-separated tenant:value pairs "
+                f"(e.g. 'base:1,a0:4'), got {pair!r}")
+        try:
+            out[name] = cast(val)
+        except ValueError:
+            raise SystemExit(f"{what}: bad value {val!r} for {name!r}")
+    return out
+
+
 def _bench_adapters(args, cfg):
     """The run's LoRA plane: (lora_cfg, {name: host adapter tree}) —
     seeded, B randomized so the M tenants are genuinely DISTINCT
@@ -252,6 +274,17 @@ def _build_gen_engine(args):
         max_queue=args.max_queue, default_deadline_ms=args.deadline_ms,
         default_max_new_tokens=args.gen_tokens,
         kv_layout=args.kv_layout,
+        # SLO-aware multi-tenancy knobs (empty maps = neutral policy;
+        # GenerationConfig treats None and absent alike). These are
+        # plain JSON-able dicts, so subprocess replica specs carry them
+        # through dataclasses.asdict(gcfg) unchanged.
+        **({"tenant_weights": args.tenant_weights_map}
+           if args.tenant_weights_map else {}),
+        **({"tenant_priorities": args.priority_mix_map}
+           if args.priority_mix_map else {}),
+        **({"tenant_slo_ttft_ms": args.tenant_slo_ms_map}
+           if args.tenant_slo_ms_map else {}),
+        preempt_retries=args.preempt_retries,
         **({"block_size": args.block_size, "n_blocks": n_blocks,
             "prefix_reuse": args.prefix_reuse,
             "paged_kernel": args.paged_kernel,
@@ -454,10 +487,12 @@ def run_gen_point(eng, qps: float, duration: float,
     streams = []
     streams_by_tenant = {t: [] for t in tenants}
     done_by_tenant = {t: 0 for t in tenants}
+    ttft_by_tenant = {t: [] for t in tenants}
     for t, cls, h in handles:
         try:
             r = h.result(timeout=120)
             ttft_ms.append(r["ttft_ms"])
+            ttft_by_tenant[t].append(r["ttft_ms"])
             ttft_cls[cls].append(r["ttft_ms"])
             tokens_out += r["n_tokens"]
             streams.append(tuple(r["tokens"]))
@@ -543,10 +578,27 @@ def run_gen_point(eng, qps: float, duration: float,
         "topology": "process" if args.replica_procs else "thread",
         "tenant_sent": sent_by_tenant,
         "tenant_completed": done_by_tenant,
+        # Bench-side per-tenant TTFT percentiles (of THIS point's
+        # completions — the engine's snapshot percentiles are
+        # engine-lifetime and, in fleet mode, per-replica): the numbers
+        # the ci.sh starvation drill bounds for the quiet tenant.
+        "tenant_ttft_ms": {
+            t: {"p50": _percentile(xs, 0.50), "p99": _percentile(xs, 0.99)}
+            for t, xs in ttft_by_tenant.items() if xs},
         "stream_digests": {t: _stream_digest(s)
                            for t, s in streams_by_tenant.items()},
         "rejected_tenant_quota": snap.get("rejected_tenant_quota", 0),
         "tenants": snap.get("tenants") or {},
+        # SLO-aware multi-tenancy fields — stamped in EVERY generate row
+        # (zeros / empty maps when the knobs are off) so consumers never
+        # key-error across modes. Preemption counters are cumulative
+        # over the engine's life, like the prefix counters above.
+        "tenant_weights": args.tenant_weights_map or {},
+        "priority_mix": args.priority_mix_map or {},
+        "tenant_slo_ms": args.tenant_slo_ms_map or {},
+        "preemptions": gen.get("preemptions_total", 0),
+        "preempt_resumed": gen.get("preempt_resumed_total", 0),
+        "preempt_exhausted": gen.get("preempt_exhausted_total", 0),
         # Speculative-decoding fields — stamped in EVERY generate row
         # (k=0 / None ratios when --spec-k is off) so consumers never
         # key-error across modes. Cumulative over the engine's life,
@@ -734,6 +786,28 @@ def main():
                         "schedule submitting ONLY this tenant's requests "
                         "(base|aK) — the single-tenant digest reference "
                         "the ci.sh multi-tenant drill compares against")
+    p.add_argument("--tenant-weights", default="",
+                   help="[generate] fair-scheduling weights as "
+                        "tenant:weight pairs, e.g. 'base:1,a0:4' — a0 "
+                        "then gets ~4x base's decode admissions under "
+                        "contention (docs/inference.md 'Fair "
+                        "scheduling, budgets, and preemption')")
+    p.add_argument("--priority-mix", default="",
+                   help="[generate] strict priority classes as "
+                        "tenant:priority pairs, e.g. 'a0:1' — higher "
+                        "classes admit first and may preempt lower "
+                        "(unnamed tenants are class 0)")
+    p.add_argument("--tenant-slo-ms", default="",
+                   help="[generate] per-tenant TTFT SLO targets as "
+                        "tenant:ms pairs, e.g. 'base:500,a0:150' — "
+                        "misses burn the hvd_tenant_slo_* series and "
+                        "steer SLO-aware fleet dispatch")
+    p.add_argument("--preempt-retries", type=int, default=3,
+                   help="[generate] evictions a stream survives before "
+                        "preempted_exhausted (GenerationConfig."
+                        "preempt_retries); the ci.sh preemption drill "
+                        "raises it so a digest-pinned run can never "
+                        "fail on an unlucky eviction streak")
     p.add_argument("--replicas", type=int, default=1,
                    help="[generate] engine replicas behind one "
                         "FleetRouter (static fleet; with --autoscale "
@@ -887,6 +961,19 @@ def main():
         p.error("--chunk-blocks must be >= 1")
     if args.host_blocks < 0:
         p.error("--host-blocks must be >= 0")
+    try:
+        args.tenant_weights_map = _parse_tenant_map(
+            args.tenant_weights, "--tenant-weights", float)
+        args.priority_mix_map = _parse_tenant_map(
+            args.priority_mix, "--priority-mix", int)
+        args.tenant_slo_ms_map = _parse_tenant_map(
+            args.tenant_slo_ms, "--tenant-slo-ms", float)
+    except SystemExit as e:
+        p.error(str(e))
+    if (args.tenant_weights_map or args.priority_mix_map
+            or args.tenant_slo_ms_map) and args.mode != "generate":
+        p.error("--tenant-weights/--priority-mix/--tenant-slo-ms apply "
+                "to --mode generate only")
     if args.mode == "generate":
         try:
             # ONE naming/weights rule — the same call the run schedule
@@ -897,6 +984,13 @@ def main():
         if args.adapter_only and args.adapter_only not in tenants:
             p.error(f"--adapter-only must be one of {tenants} "
                     f"(set --adapters first)")
+        for what, m in (("--tenant-weights", args.tenant_weights_map),
+                        ("--priority-mix", args.priority_mix_map),
+                        ("--tenant-slo-ms", args.tenant_slo_ms_map)):
+            bad = [t for t in m if t not in tenants]
+            if bad:
+                p.error(f"{what} names unknown tenant(s) {bad} — this "
+                        f"run's tenants are {tenants} (set --adapters)")
     elif args.adapter_only:
         p.error("--adapter-only applies to --mode generate only")
 

@@ -120,6 +120,26 @@ class ServerClosedError(HorovodError):
     """
 
 
+class PreemptedError(HorovodError):
+    """A generation stream was evicted from its decode slot by a
+    higher-priority admission and could not be resumed within the
+    engine's preemption retry budget.
+
+    Raised through the stream's handle by the
+    :class:`horovod_tpu.serve.GenerationEngine` preemption plane with
+    terminal reason ``preempted_exhausted`` — the scheduling analog of
+    :class:`FailoverExhaustedError`: the eviction itself is invisible
+    to a client (the engine captures the stream's envelope exactly like
+    a replica-death failover and replays it bit-identically), so only a
+    stream preempted MORE times than ``GenerationConfig.
+    preempt_retries`` ever sees this error. Under a
+    :class:`horovod_tpu.serve.FleetRouter` it is additionally a
+    failover cause: the stranded envelope is re-dispatched to another
+    replica before the budget verdict lands, so a preemption on one
+    replica can complete on a quieter one.
+    """
+
+
 class FailoverExhaustedError(HorovodError):
     """A generation stream stranded by replica death could not be
     resumed anywhere: it failed on its retry budget's worth of replicas

@@ -489,6 +489,18 @@ class BlockManager:
     delta changes the K/V a prompt writes — two tenants' identical
     token prefixes are NOT interchangeable bytes, and neither are one
     tenant's before/after a hot-reload.
+
+    **Per-tenant ownership and budgets.** Every allocated block can
+    carry an ``owner`` (the allocating tenant); the tenant salt already
+    makes prefix sharing tenant-scoped, so a block has exactly ONE
+    owner for its whole allocated life — shared-prefix retains are
+    always same-tenant. :meth:`set_budget` caps a tenant's owned
+    blocks; enforcement lives in the engine's admission path (door
+    rejection + per-tenant starvation), the manager only does the
+    ledger: :meth:`owned_count`, owner-filtered
+    :meth:`offload_candidates` (a tenant over budget offloads its OWN
+    coldest blocks first) and owner-filtered :meth:`reclaim` (it
+    evicts its own registry residue, never another tenant's cache).
     """
 
     def __init__(self, n_blocks: int, block_size: int,
@@ -510,6 +522,13 @@ class BlockManager:
         # First-block registry key -> advisory routing digest; kept
         # while the chain head lives in EITHER tier.
         self._route: Dict[bytes, str] = {}
+        # Tenant ownership ledger: block -> owning tenant for the
+        # block's allocated lifetime (registry pins included — a
+        # tenant's cache residue counts against its budget), plus the
+        # per-tenant owned counts and budgets the engine enforces.
+        self._owner: Dict[int, str] = {}
+        self._owned: Dict[str, int] = {}
+        self._budgets: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # -- gauges ------------------------------------------------------------
@@ -553,12 +572,68 @@ class BlockManager:
                     "host_used": host_used,
                     "host_free": max(0, self._host_cap - host_used)}
 
+    def tenant_gauges(self) -> Dict:
+        """Per-tenant ownership view, SEPARATE from :meth:`gauges`
+        (whose values the fleet router sums across replicas — they must
+        stay scalar): owned device blocks and configured budgets by
+        tenant, json-ready."""
+        with self._lock:
+            return {"owned": dict(sorted(self._owned.items())),
+                    "budgets": dict(sorted(self._budgets.items()))}
+
+    # -- tenant ownership / budgets -----------------------------------------
+
+    def _own(self, b: int, owner: Optional[str]) -> None:
+        """Stamp ``owner`` on block ``b`` (caller holds the lock)."""
+        if owner is None:
+            return
+        self._owner[b] = owner
+        self._owned[owner] = self._owned.get(owner, 0) + 1
+
+    def _disown(self, b: int) -> None:
+        """Clear block ``b``'s owner as it frees (caller holds the
+        lock)."""
+        owner = self._owner.pop(b, None)
+        if owner is None:
+            return
+        n = self._owned.get(owner, 1) - 1
+        if n > 0:
+            self._owned[owner] = n
+        else:
+            self._owned.pop(owner, None)
+
+    def set_budget(self, tenant: str, budget: Optional[int]) -> None:
+        """Cap ``tenant``'s owned device blocks (``None`` = unlimited).
+        Budget vs quota: a quota caps in-flight STREAMS, a budget caps
+        the tenant's slice of the device pool — the resource that one
+        long-context tenant can exhaust for everyone with a handful of
+        streams."""
+        if budget is not None and budget < 1:
+            raise ValueError(
+                f"block budget must be >= 1 or None, got {budget}")
+        with self._lock:
+            if budget is None:
+                self._budgets.pop(tenant, None)
+            else:
+                self._budgets[tenant] = int(budget)
+
+    def budget(self, tenant: str) -> Optional[int]:
+        with self._lock:
+            return self._budgets.get(tenant)
+
+    def owned_count(self, tenant: str) -> int:
+        """Device blocks currently owned by ``tenant`` — live stream
+        allocations AND its registry-pinned prefix residue."""
+        with self._lock:
+            return self._owned.get(tenant, 0)
+
     # -- allocation --------------------------------------------------------
 
-    def alloc(self, n: int) -> List[int]:
-        """Take ``n`` fresh blocks (refcount 1 each). Callers check
-        :attr:`free_count` (and :meth:`reclaim`) first; an empty pool
-        here is a bookkeeping bug, not backpressure."""
+    def alloc(self, n: int, owner: Optional[str] = None) -> List[int]:
+        """Take ``n`` fresh blocks (refcount 1 each), owned by
+        ``owner`` when given. Callers check :attr:`free_count` (and
+        :meth:`reclaim`) first; an empty pool here is a bookkeeping
+        bug, not backpressure."""
         with self._lock:
             if n > len(self._free):
                 raise RuntimeError(
@@ -568,6 +643,7 @@ class BlockManager:
             out = [self._free.pop() for _ in range(n)]
             for b in out:
                 self._ref[b] = 1
+                self._own(b, owner)
             return out
 
     def retain(self, blocks: List[int]) -> None:
@@ -591,6 +667,7 @@ class BlockManager:
                 if self._ref[b] < 0:
                     raise RuntimeError(f"double free of block {b}")
                 if self._ref[b] == 0:
+                    self._disown(b)
                     self._free.append(b)
 
     # -- prefix registry ---------------------------------------------------
@@ -638,15 +715,18 @@ class BlockManager:
                 self._registry[key] = blocks[j]
                 self._ref[blocks[j]] += 1
 
-    def reclaim(self, need_free: int) -> bool:
+    def reclaim(self, need_free: int,
+                owner: Optional[str] = None) -> bool:
         """Evict registered prefixes, LRU-first, until ``need_free``
         blocks are free. Only entries whose block's SOLE reference is
         the registry pin are evicted — popping a stream-referenced entry
         frees nothing and would just wipe the cache for future
         admissions (a transiently starved request must not disable
-        prefix reuse for everyone else). Returns whether the target was
-        met; entries skipped here free up for a later sweep when their
-        streams end."""
+        prefix reuse for everyone else). ``owner`` restricts the sweep
+        to blocks that tenant owns: an over-budget tenant reclaims its
+        OWN cache residue, never another tenant's. Returns whether the
+        target was met; entries skipped here free up for a later sweep
+        when their streams end."""
         with self._lock:
             if len(self._free) >= need_free:
                 return True
@@ -654,11 +734,14 @@ class BlockManager:
                 if len(self._free) >= need_free:
                     break
                 blk = self._registry[key]
+                if owner is not None and self._owner.get(blk) != owner:
+                    continue
                 if self._ref[blk] == 1:
                     del self._registry[key]
                     if key not in self._host:
                         self._route.pop(key, None)
                     self._ref[blk] = 0
+                    self._disown(blk)
                     self._free.append(blk)
             return len(self._free) >= need_free
 
@@ -681,11 +764,15 @@ class BlockManager:
                 out.append((key, payload))
             return out
 
-    def offload_candidates(self, n: int) -> List[Tuple[bytes, int]]:
+    def offload_candidates(self, n: int,
+                           owner: Optional[str] = None
+                           ) -> List[Tuple[bytes, int]]:
         """Up to ``n`` coldest registry entries whose block's SOLE
         reference is the registry pin — the only ones whose device bytes
         are stable to copy (no stream can be writing them) and whose
-        eviction frees a block. Read-only: the engine snapshots the
+        eviction frees a block. ``owner`` restricts the sweep to that
+        tenant's blocks (the over-budget path: a tenant offloads its
+        OWN coldest blocks first). Read-only: the engine snapshots the
         bytes, then :meth:`offload_commit` re-validates under the lock,
         so a hit that lands mid-copy simply cancels the offload."""
         if self._host_cap <= 0 or n <= 0:
@@ -695,6 +782,8 @@ class BlockManager:
             for key, blk in self._registry.items():     # LRU → MRU
                 if len(out) >= n:
                     break
+                if owner is not None and self._owner.get(blk) != owner:
+                    continue
                 if self._ref[blk] == 1:
                     out.append((key, blk))
             return out
@@ -711,6 +800,7 @@ class BlockManager:
                 return False
             del self._registry[key]
             self._ref[blk] = 0
+            self._disown(blk)
             self._free.append(blk)
             self._host[key] = payload
             self._host.move_to_end(key)
@@ -734,6 +824,7 @@ class BlockManager:
             self._host.pop(key, None)
             if key in self._registry:
                 self._ref[blk] = 0
+                self._disown(blk)
                 self._free.append(blk)
                 return False
             self._registry[key] = blk

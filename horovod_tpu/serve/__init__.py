@@ -72,6 +72,7 @@ from .generate import (  # noqa: F401
     prefill_buckets,
 )
 from .metrics import FleetMetrics, ServeMetrics  # noqa: F401
+from .sched import FairScheduler  # noqa: F401
 from .spec import (  # noqa: F401
     DraftProposer,
     NgramProposer,
@@ -112,6 +113,7 @@ from ..parallel.transformer import (  # noqa: F401
 from ..exceptions import (  # noqa: F401
     DeadlineExceededError,
     FailoverExhaustedError,
+    PreemptedError,
     ReplicaTimeoutError,
     ServerClosedError,
     ServerOverloadedError,

@@ -31,6 +31,14 @@ never see change shape:
   ``slots_full``/``blocks_exhausted`` exactly as PR 11 split those —
   an operator must see WHICH resource a tenant exhausted. ``"base"``
   (no adapter) is a quotable tenant too.
+* **Per-tenant scheduling policy.** ``weight(name)`` /
+  ``priority(name)`` / ``slo_ttft_ms(name)`` carry the fair-scheduling
+  plane's knobs (:mod:`horovod_tpu.serve.sched`): the DRR share, the
+  strict priority class (preemption-grade), and the TTFT target the
+  ``hvd_tenant_slo_*`` burn series measure against. All follow the
+  quota discipline — settable for ``"base"`` too, registry values
+  override ``GenerationConfig`` defaults, and changes apply at the
+  next admission (policy is data, never a compile key).
 
 Weights come from anywhere that yields the
 ``parallel.lora.init_adapter`` tree shape — typically
@@ -81,6 +89,13 @@ class AdapterRegistry:
         self._ref = np.zeros(self._capacity, np.int64)
         self._free: List[int] = list(range(self._capacity - 1, -1, -1))
         self._quotas: Dict[str, Optional[int]] = {}
+        # Fair-scheduling policy (serve/sched.py): DRR weights, strict
+        # priority classes, and per-tenant TTFT SLO targets. Absent =
+        # the engine's GenerationConfig default (weight 1.0, priority
+        # 0, no SLO target).
+        self._weights: Dict[str, float] = {}
+        self._priorities: Dict[str, int] = {}
+        self._slo_ttft: Dict[str, float] = {}
         # Monotone per-name load generation: bumped on EVERY load (fresh
         # and hot-reload) and never reset by evict — the engine salts
         # its prefix-reuse registry keys with (name, generation), so a
@@ -126,14 +141,26 @@ class AdapterRegistry:
     # -- load / evict ------------------------------------------------------
 
     def load(self, name: str, adapter: Any,
-             quota: Optional[int] = None) -> int:
+             quota: Optional[int] = None,
+             weight: Optional[float] = None,
+             priority: Optional[int] = None,
+             slo_ttft_ms: Optional[float] = None) -> int:
         """Stage ``adapter`` and swap it into a table row; returns the
         row index. Re-loading a resident name hot-reloads its weights in
         place — refused (``RuntimeError``) while any live stream
         references the row, for the same reason evict refuses: a
         mid-stream weight change would fork the tenant's stream. A full
-        table raises ``ValueError`` naming the capacity."""
+        table raises ``ValueError`` naming the capacity.
+        ``quota``/``weight``/``priority``/``slo_ttft_ms`` set the
+        tenant's admission and scheduling policy in the same call
+        (``None`` leaves each unset — see the ``set_*`` methods)."""
         check_adapter_name(name)
+        if weight is not None and weight <= 0:
+            raise ValueError(
+                f"scheduling weight must be > 0 or None, got {weight}")
+        if slo_ttft_ms is not None and slo_ttft_ms <= 0:
+            raise ValueError(
+                f"slo_ttft_ms must be > 0 or None, got {slo_ttft_ms}")
         check_adapter(adapter, self._model_cfg, self._lora)
         staged = jax.tree_util.tree_map(np.asarray, adapter)
         with self._lock:
@@ -159,6 +186,12 @@ class AdapterRegistry:
             self._gens[name] = self._gens.get(name, 0) + 1
             if quota is not None:
                 self._quotas[name] = int(quota)
+            if weight is not None:
+                self._weights[name] = float(weight)
+            if priority is not None:
+                self._priorities[name] = int(priority)
+            if slo_ttft_ms is not None:
+                self._slo_ttft[name] = float(slo_ttft_ms)
             self._loads_total += 1
             return row
 
@@ -181,6 +214,9 @@ class AdapterRegistry:
                     f"evict; drain the tenant first")
             del self._names[name]
             self._quotas.pop(name, None)
+            self._weights.pop(name, None)
+            self._priorities.pop(name, None)
+            self._slo_ttft.pop(name, None)
             self._free.append(row)
             self._evictions_total += 1
             listeners = list(self._evict_listeners)
@@ -264,6 +300,56 @@ class AdapterRegistry:
             else:
                 self._quotas[tenant] = int(quota)
 
+    # -- scheduling policy ---------------------------------------------------
+
+    def weight(self, tenant: str) -> Optional[float]:
+        """DRR scheduling weight for ``tenant`` (``None`` = the engine
+        default, 1.0). ``"base"`` is schedulable like any adapter."""
+        with self._lock:
+            return self._weights.get(tenant)
+
+    def set_weight(self, tenant: str, weight: Optional[float]) -> None:
+        """Applied at the next admission pick — no restart, no
+        recompile (the scheduler reads weights per pick)."""
+        if weight is not None and weight <= 0:
+            raise ValueError(
+                f"scheduling weight must be > 0 or None, got {weight}")
+        with self._lock:
+            if weight is None:
+                self._weights.pop(tenant, None)
+            else:
+                self._weights[tenant] = float(weight)
+
+    def priority(self, tenant: str) -> Optional[int]:
+        """Strict priority class for ``tenant`` (``None`` = the engine
+        default, 0; higher admits first and may preempt lower)."""
+        with self._lock:
+            return self._priorities.get(tenant)
+
+    def set_priority(self, tenant: str, priority: Optional[int]) -> None:
+        with self._lock:
+            if priority is None:
+                self._priorities.pop(tenant, None)
+            else:
+                self._priorities[tenant] = int(priority)
+
+    def slo_ttft_ms(self, tenant: str) -> Optional[float]:
+        """TTFT SLO target for ``tenant`` in ms (``None`` = no target —
+        the ``hvd_tenant_slo_*`` series stay silent for it)."""
+        with self._lock:
+            return self._slo_ttft.get(tenant)
+
+    def set_slo_ttft_ms(self, tenant: str,
+                        slo_ttft_ms: Optional[float]) -> None:
+        if slo_ttft_ms is not None and slo_ttft_ms <= 0:
+            raise ValueError(
+                f"slo_ttft_ms must be > 0 or None, got {slo_ttft_ms}")
+        with self._lock:
+            if slo_ttft_ms is None:
+                self._slo_ttft.pop(tenant, None)
+            else:
+                self._slo_ttft[tenant] = float(slo_ttft_ms)
+
     # -- gauges ------------------------------------------------------------
 
     def gauges(self) -> Dict:
@@ -277,6 +363,9 @@ class AdapterRegistry:
                 "refcounts": {n: int(self._ref[i])
                               for n, i in sorted(self._names.items())},
                 "quotas": dict(sorted(self._quotas.items())),
+                "weights": dict(sorted(self._weights.items())),
+                "priorities": dict(sorted(self._priorities.items())),
+                "slo_ttft_ms": dict(sorted(self._slo_ttft.items())),
                 "loads_total": self._loads_total,
                 "evictions_total": self._evictions_total,
             }

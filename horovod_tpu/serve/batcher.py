@@ -105,25 +105,44 @@ class RequestQueue:
         self._q: deque = deque()
         self._max = int(max_queue)
         self._closed = False
+        # Requests a consumer took with ``hold=True`` and still owns
+        # (the generation engine's held line). They left the deque but
+        # have not been served, so they still count against ``max_queue``
+        # — otherwise draining the queue into a host-side holding area
+        # would silently disable admission backpressure.
+        self._external = 0
 
     def __len__(self) -> int:
         with self._cv:
             return len(self._q)
+
+    @property
+    def held_count(self) -> int:
+        """Requests taken with ``hold=True`` and not yet released."""
+        with self._cv:
+            return self._external
 
     def put(self, req: Request) -> int:
         """Admit ``req``; returns the resulting queue depth."""
         with self._cv:
             if self._closed:
                 raise ServerClosedError("inference server is shut down")
-            if len(self._q) >= self._max:
+            if len(self._q) + self._external >= self._max:
                 raise ServerOverloadedError(
                     f"request queue full ({self._max}); retry after backoff")
             self._q.append(req)
             self._cv.notify()
             return len(self._q)
 
+    def release_held(self, n: int = 1) -> None:
+        """Return ``n`` ``hold=True`` tickets (the requests were served,
+        failed, or expired) — frees their admission capacity."""
+        with self._cv:
+            self._external = max(0, self._external - n)
+
     def take_batch(self, max_batch: int,
-                   batch_timeout_ms: float) -> List[Request]:
+                   batch_timeout_ms: float, *,
+                   hold: bool = False) -> List[Request]:
         """Block until a batch is due, then return it (possibly empty —
         an empty list means the queue was closed and fully drained).
 
@@ -138,6 +157,11 @@ class RequestQueue:
         wait sleeps exactly to the oldest request's flush deadline — a
         burst arriving mid-wait wakes it via ``put``'s notify and flushes
         at ``max_batch`` immediately.
+
+        ``hold=True`` keeps the returned requests counted against
+        ``max_queue`` until the caller hands each ticket back via
+        :meth:`release_held` — taken and returned under the same lock,
+        so no submit can thread between the dequeue and the count.
         """
         deadline_of_oldest = None
         with self._cv:
@@ -156,6 +180,8 @@ class RequestQueue:
                         batch = [self._q.popleft()
                                  for _ in range(min(max_batch,
                                                     len(self._q)))]
+                        if hold:
+                            self._external += len(batch)
                         self._cv.notify_all()
                         return batch
                     self._cv.wait(deadline_of_oldest - now)

@@ -41,10 +41,22 @@ slot-managed KV memory instead:
   (:class:`~horovod_tpu.exceptions.DeadlineExceededError` through the
   handle), graceful drain on shutdown, ``/healthz`` readiness via
   :class:`~.engine.ReadinessMixin`.
+* **Overload degrades fairly, not FIFO-unfairly**: admission into free
+  decode slots is ordered by :class:`~.sched.FairScheduler` (weighted
+  deficit round-robin over tenants, strict priority classes above it) —
+  pure host-side data, zero new compiled programs. Per-tenant KV block
+  budgets (``tenant_block_budgets``) make one tenant's
+  ``blocks_exhausted`` reject only THAT tenant; a higher-priority
+  admission that finds no slot or blocks may preempt-by-evict the
+  lowest-priority stream, capturing its envelope exactly like a
+  replica-death failover and replaying it bit-identically in place
+  (terminal reason ``preempted_exhausted`` only past
+  ``preempt_retries``).
 
 The loop is one background thread: the decode step is a single
 accelerator program, and one consumer keeps slot assignment and the
-queue's FIFO semantics trivially correct.
+queue's FIFO semantics trivially correct (fairness reorders held
+requests ACROSS tenants only; within a tenant, FIFO holds).
 """
 
 from __future__ import annotations
@@ -63,8 +75,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..exceptions import (DeadlineExceededError, ServerClosedError,
-                          ServerOverloadedError)
+from ..exceptions import (DeadlineExceededError, PreemptedError,
+                          ServerClosedError, ServerOverloadedError)
 from ..obs import flightrec
 from ..testing import faults
 from ..parallel.kv_blocks import (TRASH_BLOCK, BlockManager, blocks_for,
@@ -78,6 +90,7 @@ from .adapters import AdapterRegistry
 from .batcher import RequestQueue, bucket_for
 from .engine import ReadinessMixin
 from .metrics import ServeMetrics
+from .sched import FairScheduler
 from .spec import SpecConfig, accept_greedy, accept_sampled
 
 _DEFAULT = object()    # "knob not passed" sentinel (None is a real value)
@@ -157,6 +170,26 @@ class GenerationConfig:
     meanwhile: ``"wait"`` holds it in the queue until the prefetch lands
     (FIFO preserved), ``"miss"`` admits immediately with the device-tier
     hits only (recompute, never a stale read).
+
+    The multi-tenant scheduling policy (all host-side data — none of
+    these knobs is a compile key): ``tenant_weights`` /
+    ``tenant_priorities`` / ``tenant_slo_ttft_ms`` map tenant names
+    ("base" included) to their fair-share weight (> 0, default 1),
+    strict priority class (higher admits first and may preempt lower;
+    default 0) and TTFT SLO target in ms (feeds the
+    ``hvd_tenant_slo_*`` burn series). An attached
+    :class:`~.adapters.AdapterRegistry` row's own weight/priority/SLO
+    overrides these engine defaults per tenant. ``tenant_block_budgets``
+    (paged only) caps how many KV pool blocks a tenant may hold — over
+    budget, a tenant's admissions are rejected (``blocks_exhausted``
+    with a ``retry_after_ms`` hint) or starved WITHOUT holding any
+    other tenant's line, and the tenant offloads/reclaims its OWN
+    coldest blocks first. ``preempt``/``preempt_retries`` gate
+    preempt-by-evict: whether a higher-priority admission may evict the
+    lowest-priority active stream, and how many evictions one stream
+    survives before failing with terminal reason
+    ``preempted_exhausted``.
+
     The rest mirrors :class:`~.engine.ServeConfig`'s backpressure
     contract."""
 
@@ -175,6 +208,12 @@ class GenerationConfig:
     chunk_blocks: int = 1
     host_blocks: int = 0
     host_admission: str = "wait"
+    tenant_weights: Optional[Dict[str, float]] = None
+    tenant_priorities: Optional[Dict[str, int]] = None
+    tenant_block_budgets: Optional[Dict[str, int]] = None
+    tenant_slo_ttft_ms: Optional[Dict[str, float]] = None
+    preempt: bool = True
+    preempt_retries: int = 3
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -231,6 +270,29 @@ class GenerationConfig:
             raise ValueError(
                 "host_blocks > 0 requires prefix_reuse=True (only "
                 "registered prefixes ever offload)")
+        for t, w in (self.tenant_weights or {}).items():
+            if w <= 0:
+                raise ValueError(
+                    f"tenant_weights[{t!r}] must be > 0, got {w} (use "
+                    f"tenant_priorities, not zero weights, to de-class "
+                    f"a tenant)")
+        for t, s in (self.tenant_slo_ttft_ms or {}).items():
+            if s <= 0:
+                raise ValueError(
+                    f"tenant_slo_ttft_ms[{t!r}] must be > 0, got {s}")
+        if self.tenant_block_budgets:
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "tenant_block_budgets requires kv_layout='paged' "
+                    "(contiguous slots have no block pool to budget)")
+            for t, b in self.tenant_block_budgets.items():
+                if b < 1:
+                    raise ValueError(
+                        f"tenant_block_budgets[{t!r}] must be >= 1, "
+                        f"got {b}")
+        if self.preempt_retries < 0:
+            raise ValueError(
+                f"preempt_retries must be >= 0, got {self.preempt_retries}")
 
     @property
     def chunk_tokens(self) -> int:
@@ -358,6 +420,23 @@ class _GenRequest:
     # drafts proposed for / accepted into this stream.
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # Priority class resolved at submit (registry row, else the
+    # config map, else 0) — data the scheduler and the preemption
+    # plane read; never a compile key.
+    priority: int = 0
+    # Preemption envelope (the engine-local analog of the fleet
+    # failover replay): times this stream was evicted from its slot,
+    # and — while resuming — the already-emitted prefix to regenerate
+    # suppressed-and-verified before anything new reaches the handle.
+    retries: int = 0
+    replay_expect: Optional[List[int]] = None
+    replay_i: int = 0
+    # Held-line bookkeeping: whether this request holds a max_queue
+    # admission ticket (False for preempted re-held streams — they were
+    # admitted once already), and the host-tier prefetch keys it staged
+    # (released if it expires while parked in the held line).
+    held_ticket: bool = False
+    prefetch_keys: set = dataclasses.field(default_factory=set)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_at is None:
@@ -443,6 +522,14 @@ class GenerationEngine(ReadinessMixin):
             # Tenant churn must not grow per-tenant metric state without
             # bound: fold an evicted tenant's counters into "retired".
             adapters.add_evict_listener(self._metrics.forget_tenant)
+        # Fair admission: WDRR over tenants + strict priority classes.
+        # Weight/priority lookups go through the engine resolvers so a
+        # registry set_weight/set_priority applies from the next pick.
+        self._sched = FairScheduler(self._weight_of, self._priority_of)
+        # Block DEMAND a tenant has in flight (reserved at the door,
+        # freed at _req_done) — the budget's admission-time half; the
+        # pool's owner ledger is the occupancy half. Under _tenant_lock.
+        self._tenant_blocks: Dict[str, int] = {}
         self._paged = config.kv_layout == "paged"
         s = config.max_slots
         if self._paged:
@@ -452,6 +539,8 @@ class GenerationEngine(ReadinessMixin):
                 model_cfg, self._n_blocks, config.block_size, s)
             self._blocks = BlockManager(self._n_blocks, config.block_size,
                                         host_blocks=config.host_blocks)
+            for t, b in (config.tenant_block_budgets or {}).items():
+                self._blocks.set_budget(t, int(b))
             max_blocks = config.blocks_per_slot
             self._tables = np.full((s, max_blocks), TRASH_BLOCK, np.int32)
             self._slot_blocks: List[List[int]] = [[] for _ in range(s)]
@@ -825,8 +914,9 @@ class GenerationEngine(ReadinessMixin):
         # Token t+1's K/V lands at position L+t; the last sampled token
         # needs no cache write, so room caps new tokens at max_len-L+1.
         max_new = min(max_new, self._cfg.max_len - toks.size + 1)
+        need_blocks = 0
         if self._paged:
-            need = self._blocks_needed(toks.size, max_new)
+            need_blocks = need = self._blocks_needed(toks.size, max_new)
             if need > self._blocks.usable:
                 raise ValueError(
                     f"request needs {need} KV blocks (prompt "
@@ -856,7 +946,9 @@ class GenerationEngine(ReadinessMixin):
             salt = (f"{adapter}\x00"
                     f"{self._adapters.generation(adapter)}\x00".encode())
         try:
-            self._tenant_admit(tenant)     # raises over-quota
+            # Raises over-quota (tenant_quota) or over-block-budget
+            # (blocks_exhausted) — both with a retry_after_ms hint.
+            self._tenant_admit(tenant, need_blocks=need_blocks)
             now = time.monotonic()
             handle = GenerationHandle()
             req = _GenRequest(
@@ -866,12 +958,13 @@ class GenerationEngine(ReadinessMixin):
                              else now + deadline_ms / 1e3),
                 rng=np.random.default_rng(sampling.seed),
                 tenant=tenant, adapter=adapter, adapter_slot=a_slot,
-                prefix_salt=salt, stream_id=next(self._stream_seq))
+                prefix_salt=salt, stream_id=next(self._stream_seq),
+                priority=self._priority_of(tenant))
             handle.request = req
             try:
                 depth = self._queue.put(req)   # raises Closed / Overloaded
             except ServerOverloadedError:
-                self._tenant_release(tenant)
+                self._tenant_release(tenant, blocks=need_blocks)
                 reason, detail = self._overload_reason(toks.size, max_new)
                 self._metrics.on_overload(reason)
                 err = ServerOverloadedError(
@@ -883,7 +976,7 @@ class GenerationEngine(ReadinessMixin):
                     len(self._queue))
                 raise err from None
             except ServerClosedError:
-                self._tenant_release(tenant)
+                self._tenant_release(tenant, blocks=need_blocks)
                 raise
         except BaseException:
             if adapter is not None:
@@ -895,14 +988,28 @@ class GenerationEngine(ReadinessMixin):
                          prompt_len=int(toks.size))
         return handle
 
-    def _tenant_admit(self, tenant: str) -> None:
+    def _tenant_admit(self, tenant: str, need_blocks: int = 0) -> None:
         """Count ``tenant``'s in-flight streams (queued + decoding) and
         reject over quota — atomically, so two racing submits cannot
         both squeeze under the cap. The rejection is its own reason
         (``tenant_quota``) next to ``slots_full``/``blocks_exhausted``:
-        raising max_slots when one tenant is quota-bound fixes nothing."""
+        raising max_slots when one tenant is quota-bound fixes nothing.
+
+        With a per-tenant block budget, ``need_blocks`` is additionally
+        reserved against it HERE (released at :meth:`_req_done`): a
+        tenant whose in-flight demand would exceed its budget is
+        rejected at the door with reason ``blocks_exhausted`` — only
+        THAT tenant's admissions, never another's, and with the same
+        ``retry_after_ms`` backoff hint fleet 503s carry."""
         quota = (self._adapters.quota(tenant)
                  if self._adapters is not None else None)
+        budget = self._blocks.budget(tenant) if self._paged else None
+        if budget is not None and need_blocks > budget:
+            raise ValueError(
+                f"request needs {need_blocks} KV blocks but tenant "
+                f"{tenant!r} has a block budget of {budget} — it can "
+                f"NEVER be admitted; raise the tenant's budget or lower "
+                f"max_new_tokens")
         with self._tenant_lock:
             inflight = self._tenant_inflight.get(tenant, 0)
             if quota is not None and inflight >= quota:
@@ -913,6 +1020,20 @@ class GenerationEngine(ReadinessMixin):
                     f"raise the tenant's quota")
                 err.retry_after_ms = self._metrics.retry_after_ms(inflight)
                 raise err
+            if budget is not None:
+                demand = self._tenant_blocks.get(tenant, 0)
+                if demand + need_blocks > budget:
+                    self._metrics.on_overload("blocks_exhausted")
+                    err = ServerOverloadedError(
+                        f"tenant {tenant!r} over KV block budget: "
+                        f"{demand} blocks reserved in flight + "
+                        f"{need_blocks} needed > budget {budget} — "
+                        f"blocks_exhausted for THIS tenant only; finish "
+                        f"streams or raise tenant_block_budgets")
+                    err.retry_after_ms = self._metrics.retry_after_ms(
+                        len(self._queue))
+                    raise err
+                self._tenant_blocks[tenant] = demand + need_blocks
             self._tenant_inflight[tenant] = inflight + 1
 
     def _tenant_label(self, req: _GenRequest) -> Optional[str]:
@@ -922,25 +1043,72 @@ class GenerationEngine(ReadinessMixin):
         a ``tenants`` /stats block it has no multi-tenant plane for."""
         return req.tenant if self._adapters is not None else None
 
-    def _tenant_release(self, tenant: str) -> None:
+    def _tenant_release(self, tenant: str, blocks: int = 0) -> None:
         with self._tenant_lock:
             n = self._tenant_inflight.get(tenant, 1) - 1
             if n > 0:
                 self._tenant_inflight[tenant] = n
             else:
                 self._tenant_inflight.pop(tenant, None)
+            if blocks:
+                d = self._tenant_blocks.get(tenant, 0) - blocks
+                if d > 0:
+                    self._tenant_blocks[tenant] = d
+                else:
+                    self._tenant_blocks.pop(tenant, None)
 
     def _req_done(self, req: _GenRequest) -> None:
         """One request left the system (finished, failed, expired or
         cancelled) — the single choke point for the tenant accounting:
-        drop its in-flight count and its adapter-row reference.
+        drop its in-flight count, its block-budget demand and its
+        adapter-row reference.
         Idempotent (a drain timeout can walk the same request twice)."""
         if req._done_accounted:
             return
         req._done_accounted = True
-        self._tenant_release(req.tenant)
+        self._tenant_release(req.tenant, blocks=self._demand_of(req))
         if req.adapter is not None and self._adapters is not None:
             self._adapters.release(req.adapter)
+
+    def _demand_of(self, req: _GenRequest) -> int:
+        """The block demand :meth:`_tenant_admit` reserved for ``req``
+        (0 when its tenant has no budget) — recomputed, not stored:
+        deterministic in (prompt length, clamped max_new)."""
+        if not self._paged or self._blocks.budget(req.tenant) is None:
+            return 0
+        return self._blocks_needed(req.tokens.size, req.max_new)
+
+    # -- scheduling policy resolution ---------------------------------------
+    # Registry row first (hot-settable per tenant), engine config map
+    # second, neutral default last. Consulted at every pick/admission,
+    # so policy changes apply at the next decode-step boundary.
+
+    def _weight_of(self, tenant: str) -> float:
+        if self._adapters is not None:
+            w = self._adapters.weight(tenant)
+            if w is not None:
+                return w
+        w = (self._cfg.tenant_weights or {}).get(tenant)
+        return 1.0 if w is None else float(w)
+
+    def _priority_of(self, tenant: str) -> int:
+        if self._adapters is not None:
+            p = self._adapters.priority(tenant)
+            if p is not None:
+                return p
+        return int((self._cfg.tenant_priorities or {}).get(tenant, 0))
+
+    def _slo_of(self, tenant: str) -> Optional[float]:
+        if self._adapters is not None:
+            s = self._adapters.slo_ttft_ms(tenant)
+            if s is not None:
+                return s
+        return (self._cfg.tenant_slo_ttft_ms or {}).get(tenant)
+
+    def slo_burn(self, tenant: str) -> float:
+        """``tenant``'s SLO burn rate on this engine (0.0 when unknown)
+        — the fleet router's deprioritize-burning-replicas signal."""
+        return self._metrics.slo_burn(tenant)
 
     def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
         """KV blocks a request reserves at admission: every position it
@@ -1006,6 +1174,10 @@ class GenerationEngine(ReadinessMixin):
             snap["prefix_digests"] = (
                 list(self._blocks.route_digests())
                 if self._cfg.prefix_reuse else [])
+            # Per-tenant owned/budget block gauges — its OWN top-level
+            # key (NOT inside "blocks": the fleet router sums those
+            # gauges numerically across replicas).
+            snap["blocks_by_tenant"] = self._blocks.tenant_gauges()
         snap["last_prefill_bucket"] = self._last_prefill_bucket
         if self._adapters is not None:
             snap["adapters_resident"] = len(self._adapters.resident())
@@ -1175,39 +1347,81 @@ class GenerationEngine(ReadinessMixin):
                 free = [i for i, r in enumerate(self._slots) if r is None]
                 n_active = self._cfg.max_slots - len(free)
                 idle = n_active == 0 and not self._held
-                want = len(free) - len(self._held)
-                if want > 0 and (idle or len(self._queue)):
+                # Pull EVERYTHING queued into the held line, not just
+                # enough to fill the free slots: the scheduler is only
+                # fair across tenants it can SEE — a quiet tenant parked
+                # behind a chatty burst in the FIFO queue would
+                # otherwise be invisible to it. Held requests keep
+                # their max_queue admission ticket (``hold=True``), so
+                # the door's backpressure bound is unchanged.
+                want = len(self._queue) or (len(free) if idle else 0)
+                if want > 0:
                     # Blocks ONLY when fully idle (no active streams,
                     # nothing held, an empty queue); with streams in
                     # flight it drains whatever is queued without waiting.
-                    batch = self._queue.take_batch(want, 0.0)
+                    batch = self._queue.take_batch(want, 0.0, hold=True)
                     if not batch and idle:
                         return      # closed and drained, nothing in flight
+                    for r in batch:
+                        r.held_ticket = True
                     self._held.extend(batch)
+                self._expire_held()
+                # Admission order is the FairScheduler's pick — WDRR
+                # over tenants, strict priorities above it, FIFO within
+                # a tenant (one tenant degenerates to exact FIFO).
+                blocked: set = set()
+                budget_blocked: set = set()
                 while self._held and free:
-                    outcome = self._admit(self._held[0], free[0])
-                    if outcome == "starved":
-                        # Head-of-line request can't get KV blocks yet;
-                        # decode steps below will free some. FIFO holds —
-                        # nobody jumps the starved head.
-                        break
-                    self._held.popleft()
+                    i = self._sched.pick(self._held,
+                                         blocked=frozenset(blocked))
+                    if i is None:
+                        break   # every pending tenant is block-starved
+                    req = self._held[i]
+                    # The ticket covers the request only until its
+                    # first admission ATTEMPT — from here it is "being
+                    # served" (possibly block-starved), not "queued",
+                    # and must not count against the door (an in-
+                    # admission prefill can hold the loop for seconds).
+                    if req.held_ticket:
+                        req.held_ticket = False
+                        self._queue.release_held()
+                    outcome = self._admit(req, free[0])
+                    if outcome in ("starved", "starved_budget"):
+                        # This TENANT can't get KV blocks yet — decode
+                        # steps below will free some. Only ITS line
+                        # holds; other tenants keep admitting (the
+                        # per-tenant half of blocks_exhausted).
+                        blocked.add(req.tenant)
+                        if outcome == "starved_budget":
+                            budget_blocked.add(req.tenant)
+                        continue
+                    del self._held[i]
                     if outcome == "ok":
                         free.pop(0)
+                preempted = False
+                if (self._cfg.preempt and self._held
+                        and (not free or blocked)
+                        and any(r is not None for r in self._slots)):
+                    preempted = self._maybe_preempt(budget_blocked)
                 if any(r is not None for r in self._slots):
                     self._step_once()
-                elif self._held and self._prefetch_q:
-                    # Head-of-line request waiting on a host-tier
-                    # prefetch with nothing decoding: the staged copy
-                    # lands at the next iteration's top, then admission
-                    # retries. Not a stall — progress is the prefetch.
+                elif self._held and (self._prefetch_q or preempted):
+                    # Held requests with nothing decoding but progress
+                    # already in motion: a staged host-tier prefetch
+                    # lands at the next iteration's top, or an eviction
+                    # just freed the slot(s) the next admission pass
+                    # fills. Not a stall.
                     pass
                 elif self._held:
                     # Starved with nothing in flight: the submit-time
-                    # pool-size check makes this unreachable (every block
-                    # is free or reclaimable, and need <= usable). Fail
-                    # loudly rather than spin.
+                    # pool-size and budget checks make this unreachable
+                    # (every block is free or reclaimable — a tenant's
+                    # own residue included — and need <= usable and
+                    # <= budget). Fail loudly rather than spin.
                     req = self._held.popleft()
+                    if req.held_ticket:
+                        req.held_ticket = False
+                        self._queue.release_held()
                     req.handle._fail(ServerOverloadedError(
                         "KV block pool cannot cover an admitted request "
                         "with the engine idle — admission accounting bug"))
@@ -1245,6 +1459,141 @@ class GenerationEngine(ReadinessMixin):
             self._slot_blocks[i] = []
             self._tables[i] = TRASH_BLOCK
 
+    # -- fair scheduling + preemption ---------------------------------------
+
+    def _expire_held(self) -> None:
+        """Fail deadline-expired requests parked in the held line NOW,
+        not when they next reach a slot: an expired request must not
+        keep its reserved admission position (the max_queue ticket)
+        nor pin host-tier prefetches nobody else asked for."""
+        now = time.monotonic()
+        if not any(r.expired(now) for r in self._held):
+            return
+        expired = [r for r in self._held if r.expired(now)]
+        self._held = deque(r for r in self._held if not r.expired(now))
+        for req in expired:
+            self._metrics.on_deadline_expired(
+                (now - req.enqueued_at) * 1e3,
+                tenant=self._tenant_label(req))
+            req.handle._fail(DeadlineExceededError(
+                f"deadline expired after "
+                f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
+            self._req_done(req)
+            if req.held_ticket:
+                req.held_ticket = False
+                self._queue.release_held()
+            self._release_prefetches(req)
+
+    def _release_prefetches(self, req: _GenRequest) -> None:
+        """Drop staged host-tier prefetches only ``req`` wanted (it
+        left the held line unserved): each staged payload would burn a
+        device block on landing, for a chain no surviving admission is
+        waiting on. Keys another held request also staged stay."""
+        if not req.prefetch_keys:
+            return
+        wanted: set = set()
+        for other in self._held:
+            wanted |= other.prefetch_keys
+        drop = req.prefetch_keys - wanted
+        req.prefetch_keys = set()
+        if not drop:
+            return
+        self._prefetch_q = deque(
+            e for e in self._prefetch_q if e[0] not in drop)
+        self._prefetch_inflight -= drop
+
+    def _maybe_preempt(self, budget_blocked: set) -> bool:
+        """Preempt-by-evict: when a higher-priority pending request
+        found no free slot (or no pool blocks), evict the LOWEST-
+        priority active stream so the next iteration admits the high-
+        priority one. Tenants starved on their OWN block budget don't
+        count as waiting — evicting a neighbor frees pool blocks, never
+        budget headroom. One victim per loop iteration: eviction paces
+        with the decode steps, so a priority inversion cannot cascade
+        into a mass eviction in one beat. Returns True when a stream
+        was evicted — the loop counts that as progress (an eviction can
+        empty every slot; the freed one is filled by the NEXT
+        iteration's admission pass, not the idle-starvation guard)."""
+        now = time.monotonic()
+        waiting = [r for r in self._held
+                   if r.tenant not in budget_blocked
+                   and not r.expired(now)]
+        if not waiting:
+            return False
+        top = max(self._priority_of(r.tenant) for r in waiting)
+        # Victim: lowest priority class; ties evict the LATEST-admitted
+        # stream (the least completed work lost to replay).
+        prio, _, slot = min(
+            (self._priority_of(r.tenant), -r.stream_id, i)
+            for i, r in enumerate(self._slots) if r is not None)
+        if top > prio:
+            self._preempt(slot)
+            return True
+        return False
+
+    def _preempt(self, slot: int) -> None:
+        """Evict the stream in ``slot``, capturing its envelope exactly
+        like a replica-death failover: everything already emitted is
+        kept as an expect-prefix to regenerate suppressed-and-verified,
+        the rng restarts from the seed, the ORIGINAL absolute deadline
+        stays, and the request rejoins the held line (no new admission
+        ticket — it was admitted once). Past ``preempt_retries``
+        evictions the stream fails with terminal reason
+        ``preempted_exhausted`` instead (under a fleet router that is
+        additionally a failover cause — the envelope may still resume
+        on another replica)."""
+        req = self._slots[slot]
+        req.retries += 1
+        self._metrics.on_preempt("evicted",
+                                 tenant=self._tenant_label(req))
+        flightrec.record("serve_preempt", replica=self.serve_name,
+                         stream=req.stream_id, tenant=req.tenant,
+                         n_tokens=req.n_out, retries=req.retries)
+        self._release_slot(slot)
+        if req.retries > self._cfg.preempt_retries:
+            self._metrics.on_preempt("exhausted",
+                                     tenant=self._tenant_label(req))
+            req.handle._fail(PreemptedError(
+                f"stream {req.stream_id} (tenant {req.tenant!r}) "
+                f"evicted {req.retries} times > preempt_retries="
+                f"{self._cfg.preempt_retries}: preempted_exhausted — "
+                f"re-submit, or raise the tenant's priority or the "
+                f"retry budget"))
+            self._req_done(req)
+            return
+        req.replay_expect = list(req.handle._tokens)
+        req.replay_i = 0
+        req.n_out = 0
+        req.rng = np.random.default_rng(req.sampling.seed)
+        req.t_admit = None
+        self._held.append(req)
+
+    def _req_emit(self, req: _GenRequest, tok: int) -> None:
+        """Every sampled token flows through here. Normal streams emit
+        straight to the handle (and count in the token counters); a
+        stream resuming from preemption first regenerates its already-
+        emitted prefix SUPPRESSED — each token verified against the
+        captured envelope, none re-delivered, none re-counted — then
+        emits new tokens. Divergence is impossible under the slot-row
+        bit-identity contract, so it fails LOUDLY (an engine bug), like
+        the admission accounting guard."""
+        if req.replay_expect is not None:
+            if req.replay_i < len(req.replay_expect):
+                want = req.replay_expect[req.replay_i]
+                if tok != want:
+                    raise RuntimeError(
+                        f"preemption replay diverged on stream "
+                        f"{req.stream_id}: position {req.replay_i} "
+                        f"regenerated {tok}, envelope expected {want} — "
+                        f"the slot-row bit-identity contract is broken")
+                req.replay_i += 1
+                return
+            req.replay_expect = None
+            self._metrics.on_preempt("resumed",
+                                     tenant=self._tenant_label(req))
+        self._metrics.on_tokens(tenant=self._tenant_label(req))
+        req.handle._emit(tok)
+
     def _paged_reserve(self, req: _GenRequest):
         """Reserve the blocks ``req`` needs: prefix-registry hits are
         retained (shared), the rest freshly allocated — or None when the
@@ -1255,8 +1604,14 @@ class GenerationEngine(ReadinessMixin):
         chain entries the first lookup matched). Before hard-evicting
         registered prefixes, cold ones are OFFLOADED to the host tier
         (when configured) so a later admission can prefetch them back
-        instead of recomputing."""
+        instead of recomputing.
+
+        With a per-tenant block budget, returns ``"budget"`` when THIS
+        tenant is over its cap and cannot get under it by offloading or
+        reclaiming its OWN coldest blocks — a per-tenant starvation
+        that must never hold another tenant's admission line."""
         n_total = self._blocks_needed(req.tokens.size, req.max_new)
+        budget = self._blocks.budget(req.tenant)
         while True:
             hits = (self._blocks.lookup_prefix(req.tokens,
                                                salt=req.prefix_salt)
@@ -1266,7 +1621,7 @@ class GenerationEngine(ReadinessMixin):
                 cont = self._blocks.host_lookup(
                     req.tokens, len(hits), salt=req.prefix_salt)
                 if cont:
-                    self._stage_prefetch(cont)
+                    self._stage_prefetch(cont, req)
                     if self._cfg.host_admission == "wait":
                         return "wait"
                     # "miss": admit now on device-tier hits only — the
@@ -1283,10 +1638,25 @@ class GenerationEngine(ReadinessMixin):
                 n_hit = min(len(hits), cap)
                 hits = hits[:n_hit - n_hit % cb]
             need = n_total - len(hits)
+            if budget is not None:
+                over = (self._blocks.owned_count(req.tenant) + need
+                        - budget)
+                if over > 0:
+                    # Over ITS budget: this tenant frees its OWN coldest
+                    # blocks first — host-tier offload, then registry
+                    # reclaim — and starves ALONE if neither helps.
+                    if self._host_cap and self._offload_for(
+                            over, owner=req.tenant):
+                        continue
+                    if not self._blocks.reclaim(
+                            self._blocks.free_count + over,
+                            owner=req.tenant):
+                        return "budget"
+                    continue
             free = self._blocks.free_count
             if free >= need:
                 self._blocks.retain(hits)
-                fresh = self._blocks.alloc(need)
+                fresh = self._blocks.alloc(need, owner=req.tenant)
                 return hits, fresh, n_total
             if self._host_cap and self._offload_for(need - free):
                 continue
@@ -1295,17 +1665,20 @@ class GenerationEngine(ReadinessMixin):
 
     # -- host tier (offload / prefetch) ------------------------------------
 
-    def _offload_for(self, shortfall: int) -> bool:
+    def _offload_for(self, shortfall: int,
+                     owner: Optional[str] = None) -> bool:
         """Move up to ``shortfall`` cold registered-prefix blocks to the
         host tier (device bytes snapshotted to host numpy staging, then
         committed — the manager re-validates under its lock, so a hit
         landing mid-copy cancels that block's offload). Returns whether
-        any device block was freed."""
+        any device block was freed. ``owner`` restricts the victims to
+        that tenant's blocks (the over-budget self-offload path)."""
         # Per-block gathers with a SCALAR index: one compiled program
         # reused for every offload. A batched fancy-index gather would
         # recompile for each distinct victim-set size.
         moved = 0
-        for key, blk in self._blocks.offload_candidates(shortfall):
+        for key, blk in self._blocks.offload_candidates(shortfall,
+                                                        owner=owner):
             payload = {"k": np.asarray(self._cache["k"][:, blk]),
                        "v": np.asarray(self._cache["v"][:, blk])}
             if self._blocks.offload_commit(key, payload):
@@ -1314,16 +1687,19 @@ class GenerationEngine(ReadinessMixin):
             self._metrics.on_kv_offload(moved)
         return moved > 0
 
-    def _stage_prefetch(self, cont) -> None:
+    def _stage_prefetch(self, cont, req: _GenRequest) -> None:
         """Queue host→device copies for a chain continuation found in
         the host tier; applied at the next loop top, never inside a
-        decode step. Idempotent per key while a copy is in flight."""
+        decode step. Idempotent per key while a copy is in flight.
+        ``req`` records the keys it staged (released if it expires
+        while parked) and owns the blocks the copies will land in."""
         now = time.monotonic()
         for key, payload in cont:
+            req.prefetch_keys.add(key)
             if key in self._prefetch_inflight:
                 continue
             self._prefetch_inflight.add(key)
-            self._prefetch_q.append((key, payload, now))
+            self._prefetch_q.append((key, payload, now, req.tenant))
 
     def _apply_prefetches(self) -> None:
         """Land staged prefetches: allocate a device block, write the
@@ -1334,19 +1710,19 @@ class GenerationEngine(ReadinessMixin):
         blocks here. Writes use a SCALAR block index so the scatter
         compiles once and is reused for every prefetch."""
         for _ in range(len(self._prefetch_q)):
-            key, payload, t0 = self._prefetch_q.popleft()
+            key, payload, t0, owner = self._prefetch_q.popleft()
             if (self._blocks.free_count < 1
                     and not self._offload_for(1)
                     and not self._blocks.reclaim(1)):
                 # Evict by OFFLOAD first: landing one chain by
                 # destroying another turns the host tier's preservation
                 # into mutual eviction under rotation.
-                self._prefetch_q.append((key, payload, t0))
+                self._prefetch_q.append((key, payload, t0, owner))
                 continue
             try:
-                blk = self._blocks.alloc(1)[0]
+                blk = self._blocks.alloc(1, owner=owner)[0]
             except RuntimeError:
-                self._prefetch_q.append((key, payload, t0))
+                self._prefetch_q.append((key, payload, t0, owner))
                 continue
             k = self._cache["k"].at[:, blk].set(
                 jnp.asarray(payload["k"], self._cache["k"].dtype))
@@ -1361,13 +1737,16 @@ class GenerationEngine(ReadinessMixin):
     def _admit(self, req: _GenRequest, slot: int) -> str:
         """Prefill ``req`` into ``slot`` and emit its first token.
         Returns ``"ok"`` (slot occupied), ``"done"`` (expired, failed, or
-        finished on its first token — slot stays free), or ``"starved"``
+        finished on its first token — slot stays free), ``"starved"``
         (paged only: not enough free KV blocks yet — the request stays
-        held and the slot stays free)."""
+        held and the slot stays free), or ``"starved_budget"`` (the
+        request's TENANT is over its own block budget — only its line
+        blocks; the scheduler keeps admitting everyone else)."""
         now = time.monotonic()
         if req.expired(now):
             self._metrics.on_deadline_expired(
-                (now - req.enqueued_at) * 1e3)
+                (now - req.enqueued_at) * 1e3,
+                tenant=self._tenant_label(req))
             req.handle._fail(DeadlineExceededError(
                 f"deadline expired after "
                 f"{(now - req.enqueued_at) * 1e3:.1f} ms in queue"))
@@ -1378,10 +1757,12 @@ class GenerationEngine(ReadinessMixin):
         read_row = None
         if self._paged:
             reservation = self._paged_reserve(req)
+            if reservation == "budget":
+                return "starved_budget"
             if not isinstance(reservation, tuple):
                 # None = block-starved, "wait" = host-tier chain still
-                # prefetching; either way the request holds the FIFO
-                # head and the slot stays free.
+                # prefetching; either way the request stays held (only
+                # its own tenant's line waits) and the slot stays free.
                 return "starved"
         req.t_admit = now
         self._streams_started += 1     # the serve_hook @stream counter
@@ -1476,13 +1857,18 @@ class GenerationEngine(ReadinessMixin):
                     req.tokens, row, n_full, salt=req.prefix_salt,
                     route_digest=prefix_route_digest(
                         req.tokens, self._cfg.block_size, req.adapter))
-        req.t_first = time.monotonic()
-        self._metrics.on_first_token((req.t_first - req.enqueued_at) * 1e3,
-                                     tenant=self._tenant_label(req))
+        if req.replay_expect is None:
+            # A resuming stream's first token was already DELIVERED
+            # (and its TTFT recorded) before the eviction — re-stamping
+            # here would double-count the tenant's SLO outcomes.
+            req.t_first = time.monotonic()
+            self._metrics.on_first_token(
+                (req.t_first - req.enqueued_at) * 1e3,
+                tenant=self._tenant_label(req),
+                slo_ms=self._slo_of(req.tenant))
         tok = req.sample(logits)
         req.n_out = 1
-        self._metrics.on_tokens(tenant=self._tenant_label(req))
-        req.handle._emit(tok)
+        self._req_emit(req, tok)
         reason = self._finish_reason(req, tok, next_pos=int(req.tokens.size))
         if reason:
             self._finish(req, reason)
@@ -1539,9 +1925,14 @@ class GenerationEngine(ReadinessMixin):
                       self._cfg.max_len - int(self._positions[i]))
             if cap < 2:
                 continue
+            # [:n_out]: for a normal stream that IS the whole emitted
+            # list, but a preemption replay must draft from only the
+            # regenerated-so-far prefix — the envelope's future tokens
+            # would otherwise change the drafts, change the rng draws
+            # sampled acceptance consumes, and break bit-identity.
             ctx = np.concatenate(
                 [np.asarray(req.tokens, np.int64),
-                 np.asarray(req.handle._tokens, np.int64)])
+                 np.asarray(req.handle._tokens[:req.n_out], np.int64)])
             d = np.asarray(self._drafter.propose(ctx, min(k, cap - 1)),
                            np.int64).ravel()[:min(k, cap - 1)]
             d = d[(d >= 0) & (d < self._model_cfg.vocab)]
@@ -1570,7 +1961,7 @@ class GenerationEngine(ReadinessMixin):
         exec_ms = (time.monotonic() - t1) * 1e3
         self._peak_active = max(self._peak_active, len(active))
         self._metrics.on_batch(self._cfg.max_slots, len(active), exec_ms,
-                               len(self._queue))
+                               len(self._queue) + len(self._held))
         proposed = accepted = emitted_total = 0
         for i in active:
             req = self._slots[i]
@@ -1587,8 +1978,7 @@ class GenerationEngine(ReadinessMixin):
             for tok in cand:
                 tok = int(tok)
                 req.n_out += 1
-                self._metrics.on_tokens(tenant=self._tenant_label(req))
-                req.handle._emit(tok)
+                self._req_emit(req, tok)
                 self._positions[i] += 1
                 self._last[i] = tok
                 emitted += 1
@@ -1630,13 +2020,12 @@ class GenerationEngine(ReadinessMixin):
         active = [i for i, r in enumerate(self._slots) if r is not None]
         self._peak_active = max(self._peak_active, len(active))
         self._metrics.on_batch(self._cfg.max_slots, len(active), exec_ms,
-                               len(self._queue))
+                               len(self._queue) + len(self._held))
         for i in active:
             req = self._slots[i]
             tok = req.sample(logits_np[i])
             req.n_out += 1
-            self._metrics.on_tokens(tenant=self._tenant_label(req))
-            req.handle._emit(tok)
+            self._req_emit(req, tok)
             self._positions[i] += 1
             self._last[i] = tok
             reason = self._finish_reason(req, tok,
@@ -1654,6 +2043,16 @@ class GenerationEngine(ReadinessMixin):
         return None
 
     def _finish(self, req: _GenRequest, reason: str) -> None:
+        if (req.replay_expect is not None
+                and req.replay_i < len(req.replay_expect)):
+            # Finishing mid-replay means the regenerated stream ended
+            # EARLIER than its own recorded envelope — divergence, the
+            # same impossible-by-contract condition _req_emit guards.
+            raise RuntimeError(
+                f"preemption replay of stream {req.stream_id} finished "
+                f"({reason}) at position {req.replay_i} but its envelope "
+                f"holds {len(req.replay_expect)} tokens — the slot-row "
+                f"bit-identity contract is broken")
         now = time.monotonic()
         gen_s = now - req.t_first
         ttft_ms = (req.t_first - req.enqueued_at) * 1e3

@@ -122,6 +122,26 @@ class ServeMetrics:
         self._c_tenant_tokens = self.registry.counter(
             "hvd_tenant_tokens_generated_total",
             "Tokens sampled, by tenant", labels=("tenant",))
+        # Per-tenant SLO burn: misses counted against the tenant's OWN
+        # targets (registry/config), so the series only exist for
+        # tenants that declared an SLO — no target, no burn to measure.
+        self._c_tenant_slo_ttft_miss = self.registry.counter(
+            "hvd_tenant_slo_ttft_miss_total",
+            "First tokens later than the tenant's TTFT SLO target",
+            labels=("tenant",))
+        self._c_tenant_slo_deadline_miss = self.registry.counter(
+            "hvd_tenant_slo_deadline_miss_total",
+            "Requests expired past their deadline, by tenant",
+            labels=("tenant",))
+        self._g_tenant_slo_burn = self.registry.gauge(
+            "hvd_tenant_slo_burn",
+            "Fraction of the tenant's outcomes that burned its SLO "
+            "(TTFT misses + deadline misses over completions + "
+            "deadline misses)", labels=("tenant",))
+        self._g_tenant_slo_target = self.registry.gauge(
+            "hvd_tenant_slo_ttft_target_ms",
+            "The tenant's configured TTFT SLO target",
+            labels=("tenant",))
         self.requests_total = 0
         self.responses_total = 0
         self.rejected_overload = 0
@@ -181,6 +201,12 @@ class ServeMetrics:
         self.kv_prefetch_blocks_total = 0
         self.prefill_chunks_total = 0
         self.prefill_chunks_skipped_total = 0
+        # Preemption plane (priority-class evictions): evictions, their
+        # verdicts. resumed + exhausted <= preemptions while an evicted
+        # stream is still replaying. Zero for FIFO engines.
+        self.preemptions_total = 0
+        self.preempt_resumed_total = 0
+        self.preempt_exhausted_total = 0
         self._h_prefetch = self.registry.histogram(
             "hvd_kv_prefetch_seconds",
             "Host-to-device prefetch latency per block chain")
@@ -211,10 +237,19 @@ class ServeMetrics:
             else:
                 self.rejected_slots_full += 1
 
-    def on_deadline_expired(self, queue_ms: float) -> None:
+    def on_deadline_expired(self, queue_ms: float,
+                            tenant: Optional[str] = None) -> None:
+        """``tenant`` additionally counts the expiry against the
+        tenant's SLO burn — a deadline miss is the worst burn outcome,
+        target or no target."""
         with self._lock:
             self.expired_deadline += 1
             self._queue_ms.add(queue_ms)
+            if tenant is not None:
+                self._tenant(tenant)["deadline_miss_total"] += 1
+                self._refresh_burn(tenant)
+        if tenant is not None:
+            self._c_tenant_slo_deadline_miss.labels(tenant=tenant).inc()
 
     def on_shutdown_cancel(self, n: int) -> None:
         with self._lock:
@@ -246,22 +281,55 @@ class ServeMetrics:
         if t is None:
             t = self._tenants[name] = {
                 "generations_total": 0, "tokens_generated_total": 0,
+                "first_tokens_total": 0, "ttft_slo_miss_total": 0,
+                "deadline_miss_total": 0, "preemptions_total": 0,
+                "slo_ttft_target_ms": None,
                 "_ttft": _Reservoir(seed=5), "_tps": _Reservoir(seed=6)}
         return t
 
+    @staticmethod
+    def _burn(t: Dict) -> float:
+        """SLO burn fraction of one tenant bundle: misses over
+        outcomes. Deadline misses count in BOTH halves — an expired
+        request never produced a first token, so its only trace is the
+        miss itself."""
+        misses = t["ttft_slo_miss_total"] + t["deadline_miss_total"]
+        outcomes = t["first_tokens_total"] + t["deadline_miss_total"]
+        return misses / outcomes if outcomes else 0.0
+
+    def _refresh_burn(self, tenant: str) -> None:
+        """Re-publish the tenant's burn gauge (caller holds the lock)."""
+        self._g_tenant_slo_burn.labels(tenant=tenant).set(
+            self._burn(self._tenants[tenant]))
+
     def on_first_token(self, ttft_ms: float,
-                       tenant: Optional[str] = None) -> None:
+                       tenant: Optional[str] = None,
+                       slo_ms: Optional[float] = None) -> None:
         """Time-to-first-token: submit → the prefill's sampled token. The
         latency a generation user actually perceives as 'responsiveness'
         — decode throughput is a separate number (below). ``tenant``
-        additionally records the multi-tenant split."""
+        additionally records the multi-tenant split; ``slo_ms`` is the
+        tenant's TTFT target — a first token past it counts one SLO
+        miss."""
         with self._lock:
             self._ttft_ms.add(ttft_ms)
             if tenant is not None:
-                self._tenant(tenant)["_ttft"].add(ttft_ms)
+                t = self._tenant(tenant)
+                t["_ttft"].add(ttft_ms)
+                t["first_tokens_total"] += 1
+                missed = slo_ms is not None and ttft_ms > slo_ms
+                if slo_ms is not None:
+                    t["slo_ttft_target_ms"] = float(slo_ms)
+                    self._g_tenant_slo_target.labels(
+                        tenant=tenant).set(float(slo_ms))
+                if missed:
+                    t["ttft_slo_miss_total"] += 1
+                self._refresh_burn(tenant)
         self._h_ttft.observe(ttft_ms / 1e3)
         if tenant is not None:
             self._h_tenant_ttft.labels(tenant=tenant).observe(ttft_ms / 1e3)
+            if missed:
+                self._c_tenant_slo_ttft_miss.labels(tenant=tenant).inc()
 
     def on_tokens(self, n: int = 1, tenant: Optional[str] = None) -> None:
         with self._lock:
@@ -322,6 +390,38 @@ class ServeMetrics:
             self.prefill_chunks_total += n_chunks
             self.prefill_chunks_skipped_total += n_skipped
 
+    def on_preempt(self, outcome: str,
+                   tenant: Optional[str] = None) -> None:
+        """One preemption-plane event: ``"evicted"`` (a lower-priority
+        stream's slot was taken — ``tenant`` is the EVICTED tenant),
+        ``"resumed"`` (its replay caught up and the stream continued
+        bit-identically) or ``"exhausted"`` (evicted more times than
+        the retry budget — terminal ``preempted_exhausted``).
+        Deliberately separate from the failover counters: fleet
+        failover churn and scheduling pressure are different operator
+        problems."""
+        if outcome not in ("evicted", "resumed", "exhausted"):
+            raise ValueError(
+                f"preempt outcome must be 'evicted', 'resumed' or "
+                f"'exhausted', got {outcome!r}")
+        with self._lock:
+            if outcome == "evicted":
+                self.preemptions_total += 1
+                if tenant is not None:
+                    self._tenant(tenant)["preemptions_total"] += 1
+            elif outcome == "resumed":
+                self.preempt_resumed_total += 1
+            else:
+                self.preempt_exhausted_total += 1
+
+    def slo_burn(self, tenant: str) -> float:
+        """The tenant's current SLO burn fraction (0.0 when unknown) —
+        the router's dispatch signal: replicas already burning a
+        tenant's SLO are deprioritized for that tenant's traffic."""
+        with self._lock:
+            t = self._tenants.get(tenant)
+            return self._burn(t) if t is not None else 0.0
+
     def retry_after_ms(self, queue_depth: int) -> float:
         """Backoff hint for an overload rejection: roughly how long
         until the CURRENT queue has drained, from the engine's own
@@ -360,13 +460,21 @@ class ServeMetrics:
             r = self._tenant("retired")
             r["generations_total"] += t["generations_total"]
             r["tokens_generated_total"] += t["tokens_generated_total"]
-        for metric in (self._c_tenant_generations, self._c_tenant_tokens):
+            r["first_tokens_total"] += t["first_tokens_total"]
+            r["ttft_slo_miss_total"] += t["ttft_slo_miss_total"]
+            r["deadline_miss_total"] += t["deadline_miss_total"]
+            r["preemptions_total"] += t["preemptions_total"]
+        for metric in (self._c_tenant_generations, self._c_tenant_tokens,
+                       self._c_tenant_slo_ttft_miss,
+                       self._c_tenant_slo_deadline_miss):
             count = metric.labels(tenant=tenant).value
             metric.remove(tenant=tenant)
             if count > 0:
                 metric.labels(tenant="retired").inc(count)
         self._h_tenant_ttft.remove(tenant=tenant)
         self._h_tenant_tps.remove(tenant=tenant)
+        self._g_tenant_slo_burn.remove(tenant=tenant)
+        self._g_tenant_slo_target.remove(tenant=tenant)
 
     def ttft_totals(self) -> Tuple[float, int]:
         """Cumulative ``(seconds_sum, count)`` of the TTFT histogram —
@@ -454,6 +562,10 @@ class ServeMetrics:
                     "prefill_chunks_total": self.prefill_chunks_total,
                     "prefill_chunks_skipped_total":
                         self.prefill_chunks_skipped_total,
+                    "preemptions_total": self.preemptions_total,
+                    "preempt_resumed_total": self.preempt_resumed_total,
+                    "preempt_exhausted_total":
+                        self.preempt_exhausted_total,
                     "ttft_p50": self._ttft_ms.quantile(0.50),
                     "ttft_p99": self._ttft_ms.quantile(0.99),
                     "tokens_per_sec_user_p50": self._tps_user.quantile(0.50),
@@ -490,6 +602,12 @@ class ServeMetrics:
                         "generations_total": t["generations_total"],
                         "tokens_generated_total":
                             t["tokens_generated_total"],
+                        "first_tokens_total": t["first_tokens_total"],
+                        "ttft_slo_miss_total": t["ttft_slo_miss_total"],
+                        "deadline_miss_total": t["deadline_miss_total"],
+                        "preemptions_total": t["preemptions_total"],
+                        "slo_ttft_target_ms": t["slo_ttft_target_ms"],
+                        "slo_burn": self._burn(t),
                         "ttft_p50": t["_ttft"].quantile(0.50),
                         "ttft_p99": t["_ttft"].quantile(0.99),
                         "tokens_per_sec_user_p50":
@@ -576,6 +694,15 @@ _GENERATION = {
                                      "counter",
                                      "Prefill scan chunks skipped via "
                                      "prefix hits"),
+    "preemptions_total": ("hvd_preemptions_total", "counter",
+                          "Streams evicted from a decode slot by a "
+                          "higher-priority admission"),
+    "preempt_resumed_total": ("hvd_preempt_resumed_total", "counter",
+                              "Preempted streams resumed "
+                              "bit-identically"),
+    "preempt_exhausted_total": ("hvd_preempt_exhausted_total", "counter",
+                                "Preempted streams terminated on their "
+                                "retry budget"),
 }
 
 _SPEC = {

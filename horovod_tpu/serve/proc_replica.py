@@ -68,9 +68,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from ..exceptions import (DeadlineExceededError, ReplicaTimeoutError,
-                          ServerClosedError, ServerOverloadedError,
-                          WorkerFailureError)
+from ..exceptions import (DeadlineExceededError, PreemptedError,
+                          ReplicaTimeoutError, ServerClosedError,
+                          ServerOverloadedError, WorkerFailureError)
 from .generate import GenerationHandle
 
 _DEFAULT = object()     # mirrors generate.submit's eos_id sentinel
@@ -280,6 +280,19 @@ class ProcReplicaClient:
         bs = self._last_stats.get("block_size")
         return bs if isinstance(bs, int) and bs > 0 else None
 
+    def slo_burn(self, tenant: str) -> float:
+        """The child's current SLO burn fraction for ``tenant`` (0.0
+        when unknown) — the router's SLO-aware dispatch signal, read
+        from the stats cache (``load()`` refreshes it every dispatch
+        walk, so the signal is at most one walk stale; a fresh HTTP
+        fetch per sort key would multiply the dispatch round trips by
+        the fleet size)."""
+        t = (self._last_stats.get("tenants") or {}).get(tenant)
+        if not isinstance(t, dict):
+            return 0.0
+        burn = t.get("slo_burn")
+        return float(burn) if isinstance(burn, (int, float)) else 0.0
+
     def _active_rows(self) -> int:
         """Best-effort active-slot count for the router's fleet peak
         sampling — read from the stats cache (a fresh HTTP fetch per
@@ -471,6 +484,11 @@ class ProcReplicaClient:
         msg = str(body.get("error", f"HTTP {status}"))
         if status == 503:
             if body.get("retryable", True):
+                # (A PreemptedError repr can land here too — preempted
+                # past the budget before the FIRST token. At submit time
+                # that is retryable overload: the dispatch walk tries the
+                # next door. Only the mid-stream error line keeps the
+                # typed verdict, via _wire_error.)
                 err = ServerOverloadedError(msg)
                 ra = body.get("retry_after_ms")
                 if isinstance(ra, (int, float)):
@@ -519,6 +537,13 @@ class ProcReplicaClient:
     def _wire_error(self, text: str) -> Exception:
         if text.startswith("DeadlineExceededError"):
             return DeadlineExceededError(text)
+        if text.startswith("PreemptedError"):
+            # Keep the preemption verdict typed across the wire: the
+            # router fails it over like any strand, but a FLEET-level
+            # exhaustion must still report terminal reason
+            # "preempted_exhausted" (priority congestion), not replica
+            # death.
+            return PreemptedError(f"replica {self.name}: {text}")
         return WorkerFailureError(f"replica {self.name}: {text}")
 
     def generate(self, tokens, timeout: Optional[float] = None, **kw):
@@ -721,13 +746,16 @@ def _resolve_dtype(jnp, name):
 def _build_adapters(mcfg, ad: Optional[Dict[str, Any]]):
     """The worker's adapter plane from the spec's JSON ``"adapters"``
     block: ``{"rank", "alpha", "capacity", "entries": [{"name", "seed",
-    "b_scale", "quota"}, ...]}``. Trees are re-derived from per-entry
-    seeds (``init_adapter(PRNGKey(seed), ...)``), not shipped as bytes —
+    "b_scale", "quota", "weight", "priority", "slo_ttft_ms"}, ...]}``.
+    Trees are re-derived from per-entry seeds
+    (``init_adapter(PRNGKey(seed), ...)``), not shipped as bytes —
     the same trick the base params use, so a replacement child after a
     SIGKILL holds bit-identical tables and per-tenant failover replay
-    stays digest-exact. ``quota`` (optional, per entry) caps that
-    tenant's in-flight streams; a ``"base_quota"`` key quotas the
-    no-adapter tenant."""
+    stays digest-exact. Per entry, all optional: ``quota`` caps that
+    tenant's in-flight streams, ``weight``/``priority`` set its fair-
+    scheduling class, ``slo_ttft_ms`` its TTFT SLO target. The
+    no-adapter tenant takes the same knobs spelled ``"base_quota"``,
+    ``"base_weight"``, ``"base_priority"``, ``"base_slo_ttft_ms"``."""
     if not ad:
         return None
     import jax
@@ -746,12 +774,28 @@ def _build_adapters(mcfg, ad: Optional[Dict[str, Any]]):
         tree = init_adapter(jax.random.PRNGKey(int(e["seed"])), mcfg,
                             lora, b_scale=float(e.get("b_scale", 0.0)))
         q = e.get("quota")
-        reg.load(str(e["name"]), tree,
-                 quota=int(q) if q is not None else None)
+        name = str(e["name"])
+        reg.load(name, tree, quota=int(q) if q is not None else None)
+        _apply_policy(reg, name, e.get("weight"), e.get("priority"),
+                      e.get("slo_ttft_ms"))
     bq = ad.get("base_quota")
     if bq is not None:
         reg.set_quota("base", int(bq))
+    _apply_policy(reg, "base", ad.get("base_weight"),
+                  ad.get("base_priority"), ad.get("base_slo_ttft_ms"))
     return reg
+
+
+def _apply_policy(reg, tenant: str, weight, priority, slo_ttft_ms) -> None:
+    """Stamp one tenant's optional scheduling policy onto the registry
+    (absent keys leave the engine defaults: weight 1.0, priority 0, no
+    SLO)."""
+    if weight is not None:
+        reg.set_weight(tenant, float(weight))
+    if priority is not None:
+        reg.set_priority(tenant, int(priority))
+    if slo_ttft_ms is not None:
+        reg.set_slo_ttft_ms(tenant, float(slo_ttft_ms))
 
 
 def worker_main(argv: Optional[List[str]] = None) -> int:

@@ -80,8 +80,9 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..exceptions import (DeadlineExceededError, FailoverExhaustedError,
-                          ReplicaTimeoutError, ServerClosedError,
-                          ServerOverloadedError, WorkerFailureError)
+                          PreemptedError, ReplicaTimeoutError,
+                          ServerClosedError, ServerOverloadedError,
+                          WorkerFailureError)
 from ..obs import flightrec
 from ..parallel.kv_blocks import prefix_route_digest
 from .generate import GenerationHandle
@@ -700,6 +701,31 @@ class FleetRouter:
             affine[h.name] = d in digests
         return affine
 
+    @staticmethod
+    def _slo_burning(ready: List[ReplicaHandle],
+                     adapter: Optional[str]) -> Dict[str, bool]:
+        """Which ready replicas are currently burning this tenant's
+        TTFT SLO (``{name: burning}``) — the engine's own
+        ``slo_burn(tenant)`` fraction, > 0 meaning recent first tokens
+        (or deadline expiries) missed the tenant's declared target
+        there. Requests without an adapter are the ``"base"`` tenant.
+        Purely advisory like prefix affinity: engines without the
+        probe, tenants without an SLO, and a dying replica's read error
+        all report not-burning (no key), so a fleet with no SLOs sorts
+        exactly as before."""
+        burning: Dict[str, bool] = {}
+        tenant = adapter if adapter is not None else "base"
+        for h in ready:
+            fn = getattr(h.engine, "slo_burn", None)
+            if not callable(fn):
+                continue
+            try:
+                if fn(tenant) > 0.0:
+                    burning[h.name] = True
+            except Exception:  # noqa: BLE001 — advisory only
+                continue
+        return burning
+
     def _lazy_load(self, handle: ReplicaHandle, adapter: str) -> None:
         """The affinity-miss path: fetch the adapter from
         ``adapter_source`` and hot-load it into ``handle`` before the
@@ -793,16 +819,25 @@ class FleetRouter:
         # adapter residency still outranks it (a lazy adapter load is
         # strictly costlier than a cold prefill), load still tiebreaks.
         affine = self._prefix_affinity(ready, tokens, adapter)
+        # SLO-aware dispatch: a replica already BURNING this tenant's
+        # TTFT SLO (its local burn fraction > 0) sorts after clean peers
+        # — below affinity (warm state still wins: a cold prefill or
+        # lazy adapter load would burn the SLO harder than a queue) but
+        # above raw load, so equally-warm replicas shed a struggling
+        # tenant toward doors that are still meeting its target.
+        burning = self._slo_burning(ready, adapter)
         if adapter is not None:
             resident = {h.name: adapter in self._resident_names(h)
                         for h in ready}
             ready.sort(key=lambda h: (h.name == avoid,
                                       not resident[h.name],
                                       not affine.get(h.name, False),
+                                      burning.get(h.name, False),
                                       h.load()))
         else:
             ready.sort(key=lambda h: (h.name == avoid,
                                       not affine.get(h.name, False),
+                                      burning.get(h.name, False),
                                       h.load()))
         if not ready:
             warming = sum(1 for h in snapshot if h.state() == "warming")
@@ -1065,6 +1100,13 @@ class FleetRouter:
                     self._unregister(stream)
                     client._fail(val)
                     return
+                # PreemptedError (the replica's priority plane evicted
+                # this stream past its LOCAL retry budget,
+                # ``preempted_exhausted``) deliberately falls through to
+                # failover: the verdict is one replica's congestion, not
+                # the stream's fault — another replica may have priority
+                # headroom, and the replay is the same bit-identical
+                # suppressed-prefix machinery preemption resume uses.
                 if not self._failover(stream, val):
                     return      # terminal: the client was failed
 
@@ -1190,11 +1232,20 @@ class FleetRouter:
             return True
         stream.unconfirmed = 0      # nothing re-dispatched stuck
         self._metrics.on_failover("exhausted")
-        stream.client._fail(FailoverExhaustedError(
+        # A stream stranded by PREEMPTION (not replica death) carries
+        # the engine's terminal reason through the fleet verdict: the
+        # client distinguishes "the fleet is priority-congested for my
+        # class" (back off, or raise priority) from "replicas kept
+        # dying" (page the operator).
+        reason = ("preempted_exhausted"
+                  if isinstance(cause, PreemptedError) else "exhausted")
+        err = FailoverExhaustedError(
             f"stream {stream.sid} could not be resumed "
-            f"(re-dispatched {stream.retries} time(s); stranded on "
-            f"{prev} by {cause!r}; last: {last!r}) — re-submit from "
-            f"scratch"))
+            f"({reason}; re-dispatched {stream.retries} time(s); "
+            f"stranded on {prev} by {cause!r}; last: {last!r}) — "
+            f"re-submit from scratch")
+        err.reason = reason
+        stream.client._fail(err)
         return False
 
     def generate(self, tokens, timeout: Optional[float] = None, **kw):
@@ -1316,12 +1367,20 @@ class FleetRouter:
                      "prefix_hits_total", "prefix_misses_total",
                      "prefix_hit_blocks_total", "prefix_lookup_blocks_total",
                      "kv_offload_blocks_total", "kv_prefetch_blocks_total",
-                     "prefill_chunks_total", "prefill_chunks_skipped_total")
+                     "prefill_chunks_total", "prefill_chunks_skipped_total",
+                     "preemptions_total", "preempt_resumed_total",
+                     "preempt_exhausted_total")
     # Per-tenant counters summed across replicas (+ retired baselines —
     # same monotonicity rule); tenant percentile fields cannot be summed
     # and stay in the nested per-replica snapshots (scrape the
-    # hvd_tenant_* histograms for fleet-wide tenant quantiles).
-    _TENANT_SUM_KEYS = ("generations_total", "tokens_generated_total")
+    # hvd_tenant_* histograms for fleet-wide tenant quantiles). The
+    # fleet-wide SLO burn is RECOMPUTED from these summed counters in
+    # stats() — averaging per-replica burn fractions would weight an
+    # idle replica's one miss equally with a busy replica's thousand
+    # hits.
+    _TENANT_SUM_KEYS = ("generations_total", "tokens_generated_total",
+                        "first_tokens_total", "ttft_slo_miss_total",
+                        "deadline_miss_total", "preemptions_total")
     # Speculative-decoding counters summed across replicas (+ retired
     # baselines). The derived ratios (accept_rate, tokens_per_step) are
     # recomputed fleet-wide from the summed counters — averaging
@@ -1426,6 +1485,19 @@ class FleetRouter:
                     if isinstance(v, (int, float)) \
                             and not isinstance(v, bool):
                         agg[key] = agg.get(key, 0) + v
+        # Fleet-wide SLO burn per tenant, recomputed from the summed
+        # counters (see _TENANT_SUM_KEYS): misses over SLO-scoped
+        # outcomes, exactly the per-engine ServeMetrics._burn formula.
+        fleet_slo: Dict[str, float] = {}
+        for name, agg in tenants.items():
+            outcomes = (agg.get("first_tokens_total", 0)
+                        + agg.get("deadline_miss_total", 0))
+            if outcomes:
+                burn = (agg.get("ttft_slo_miss_total", 0)
+                        + agg.get("deadline_miss_total", 0)) / outcomes
+                agg["slo_burn"] = burn
+                if burn > 0:
+                    fleet_slo[name] = burn
         if tenants:
             snap["tenants"] = tenants
         k = self.adapters_resident()
@@ -1446,6 +1518,10 @@ class FleetRouter:
                if adapter_dispatch else {}),
             **({"prefix_dispatch": prefix_dispatch}
                if prefix_dispatch else {}),
+            # Tenants currently burning their SLO fleet-wide (burn > 0)
+            # — the at-a-glance overload triage block; per-tenant detail
+            # (targets, misses, percentiles) lives under "tenants".
+            **({"slo_burning": fleet_slo} if fleet_slo else {}),
         }
         return snap
 

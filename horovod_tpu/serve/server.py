@@ -49,8 +49,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..exceptions import (DeadlineExceededError, ServerClosedError,
-                          ServerOverloadedError)
+from ..exceptions import (DeadlineExceededError, PreemptedError,
+                          ServerClosedError, ServerOverloadedError)
 from .engine import Engine
 
 
@@ -237,6 +237,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._overloaded(e)
         except DeadlineExceededError as e:
             self._reply(504, {"error": str(e)})
+        except PreemptedError as e:
+            # Preempted past the retry budget BEFORE the first token
+            # (headers not sent yet): a retryable 503, with the typed
+            # repr in the body so a subprocess-replica client can map it
+            # back to PreemptedError (mid-stream exhaustion already rides
+            # the terminal error line as a repr).
+            self._reply(503, {"error": repr(e), "retryable": True})
         except ServerClosedError as e:
             self._reply(503, {"error": str(e), "retryable": False})
         except ValueError as e:

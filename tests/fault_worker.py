@@ -58,7 +58,11 @@ def main():
             hvd.allreduce(x, name=f"post_{i}")
             i += 1
         except StalledError:
-            continue  # rank 1 still alive but asleep — retry
+            # rank 1 still alive but asleep. A stalled name is burned at
+            # the coordinator (resubmit raises ValueError), so retry
+            # under a FRESH name.
+            i += 1
+            continue
         except WorkerFailureError as e:
             failure = e
             break

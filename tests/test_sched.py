@@ -1,0 +1,587 @@
+"""SLO-aware multi-tenancy tests: FairScheduler WDRR/priority units,
+per-tenant KV block budgets (door rejection with a backoff hint, strict
+isolation, demand returning to zero), digest-pinned preempt-by-evict
+(greedy AND seeded, both KV layouts, zero new compiled programs),
+held-line deadline expiry releasing the admission ticket, SLO burn
+metrics, and SLO-aware fleet dispatch on fake engines.
+
+Budget-conscious (tier-1 sits ~440s of the 870s cap): the same tiny
+module-scoped model as tests/test_adapters.py, every prompt in ONE
+prefill bucket (9 tokens -> the 16 bucket), engines shared through
+module fixtures wherever a test only reads streams or counter DELTAS;
+the open-loop starvation drill and the serve_bench preemption-digest leg
+live in ci.sh, not here. Timing style per repo policy: generous waits,
+no elapsed-time asserts.
+"""
+
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import serve
+from horovod_tpu.exceptions import (DeadlineExceededError, PreemptedError,
+                                    ServerOverloadedError)
+from horovod_tpu.parallel.lora import LoraConfig, init_adapter
+from horovod_tpu.parallel.transformer import TransformerConfig, init_params
+from horovod_tpu.serve.adapters import AdapterRegistry
+from horovod_tpu.serve.engine import ReadinessMixin
+from horovod_tpu.serve.metrics import ServeMetrics
+from horovod_tpu.serve.router import FleetRouter
+from horovod_tpu.serve.sched import FairScheduler
+
+CFG = dict(vocab=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           dtype=jnp.float32, unembed_dtype=jnp.float32,
+           attn_backend="xla")
+
+# 9 tokens -> the 16 bucket for every engine in this module (one prefill
+# + one decode compile per engine, as in test_adapters.py).
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+PROMPT2 = [2, 7, 1, 8, 2, 8, 1, 8, 2]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = TransformerConfig(**CFG)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def lora_setup(model):
+    cfg, _ = model
+    lora = LoraConfig(rank=2)
+    ads = {f"a{i}": init_adapter(jax.random.PRNGKey(1 + i), cfg, lora,
+                                 b_scale=0.5)
+           for i in range(2)}
+    return lora, ads
+
+
+def _registry(model, lora_setup, names=("a0",), capacity=3):
+    cfg, _ = model
+    lora, ads = lora_setup
+    reg = AdapterRegistry(cfg, lora, capacity=capacity)
+    for name in names:
+        reg.load(name, ads[name])
+    return reg
+
+
+def _engine(params, cfg, adapters=None, **kw):
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("default_max_new_tokens", 48)
+    return serve.GenerationEngine(params, cfg,
+                                  serve.GenerationConfig(**kw),
+                                  adapters=adapters)
+
+
+def _r(tenant):
+    return SimpleNamespace(tenant=tenant)
+
+
+# -- FairScheduler units (no model) -----------------------------------------
+
+
+class TestFairScheduler:
+    def test_weighted_share_is_proportional(self):
+        """Deep backlogs for a (weight 3) and b (weight 1): over any
+        4k-pick window a gets 3k admissions — weights are shares, not
+        priorities."""
+        sched = FairScheduler({"a": 3.0, "b": 1.0}.__getitem__)
+        held = [_r("a")] * 8 + [_r("b")] * 8
+        picks = [held[sched.pick(held)].tenant for _ in range(8)]
+        assert picks.count("a") == 6 and picks.count("b") == 2
+
+    def test_single_tenant_degenerates_to_fifo(self):
+        """One tenant -> the pick is ALWAYS its first held request:
+        fairness reorders across tenants only (the existing single-
+        tenant digest drills are pinned on this)."""
+        sched = FairScheduler(lambda t: 1.0)
+        held = [_r("base")] * 5
+        for _ in range(5):
+            assert sched.pick(held) == 0
+
+    def test_fifo_within_a_tenant(self):
+        """Only a tenant's FIRST held request is ever considered, so
+        the pick index always names the earliest arrival."""
+        sched = FairScheduler(lambda t: 1.0)
+        held = [_r("a"), _r("a"), _r("b"), _r("a")]
+        assert sched.pick(held) in (0, 2)        # never 1 or 3
+
+    def test_no_banking_across_idle_gaps(self):
+        """An idle tenant's deficit resets: returning after a gap it
+        cannot burst past its fair share."""
+        sched = FairScheduler({"a": 1.0, "b": 1.0}.__getitem__)
+        # b alone for a while: b's picks must not bank credit for a...
+        held_b = [_r("b")] * 4
+        for _ in range(4):
+            assert held_b[sched.pick(held_b)].tenant == "b"
+        # ...nor leave a with saved-up deficit: with both pending, the
+        # 2-pick window is still split 1:1.
+        held = [_r("a")] * 4 + [_r("b")] * 4
+        picks = [held[sched.pick(held)].tenant for _ in range(4)]
+        assert picks.count("a") == 2 and picks.count("b") == 2
+
+    def test_blocked_tenant_keeps_deficit_and_holds_nobody(self):
+        """A budget-starved tenant is skipped (its line must not hold
+        anyone else's) but KEEPS its earned deficit — throttled, not
+        idle, so unblocking resumes from where it was throttled."""
+        sched = FairScheduler({"a": 2.0, "b": 1.0}.__getitem__)
+        held = [_r("a")] * 4 + [_r("b")] * 4
+        assert held[sched.pick(held)].tenant == "a"  # a=1 banked, b=1
+        for _ in range(2):
+            i = sched.pick(held, blocked=frozenset({"a"}))
+            assert held[i].tenant == "b"             # a's line holds nobody
+        # a unblocks with its pre-starvation credit intact: it is the
+        # only tenant above the pick threshold and wins immediately.
+        assert held[sched.pick(held)].tenant == "a"
+
+    def test_all_blocked_returns_none(self):
+        sched = FairScheduler(lambda t: 1.0)
+        assert sched.pick([_r("a")], blocked=frozenset({"a"})) is None
+        assert sched.pick([]) is None
+
+    def test_priority_class_is_strict(self):
+        """A pending higher class always admits first, regardless of
+        how the weights compare."""
+        sched = FairScheduler({"lo": 100.0, "hi": 1.0}.__getitem__,
+                              {"lo": 0, "hi": 1}.__getitem__)
+        held = [_r("lo")] * 4 + [_r("hi")] * 2
+        order = []
+        for _ in range(4):                  # admitted requests LEAVE
+            order.append(held.pop(sched.pick(held)).tenant)
+        assert order == ["hi", "hi", "lo", "lo"]
+
+    def test_nonpositive_weight_raises(self):
+        sched = FairScheduler(lambda t: 0.0)
+        with pytest.raises(ValueError, match="weight"):
+            sched.pick([_r("a")])
+
+    def test_forget_drops_deficit(self):
+        sched = FairScheduler(lambda t: 1.0)
+        sched.pick([_r("a")], blocked=frozenset({"b"}))
+        sched.forget("a")
+        sched.forget("never-seen")              # idempotent
+        assert sched._deficit.get("a") is None
+
+
+# -- per-tenant KV block budgets --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def budget_engine(model, lora_setup):
+    """Paged multi-tenant engine with a0 budgeted at 2 blocks (exactly
+    one in-flight stream at max_new<=8): block_size=8, max_len=16,
+    4 slots, default 9-block pool."""
+    cfg, params = model
+    reg = _registry(model, lora_setup, names=("a0", "a1"))
+    eng = _engine(params, cfg, adapters=reg, max_slots=4, max_len=16,
+                  default_max_new_tokens=6, kv_layout="paged",
+                  block_size=8, tenant_block_budgets={"a0": 2})
+    yield eng
+    eng.shutdown()
+
+
+class TestBlockBudgets:
+    def test_over_budget_rejects_only_that_tenant(self, budget_engine):
+        """a0's second in-flight stream exceeds its 2-block budget and
+        is rejected with reason blocks_exhausted and a retry_after_ms
+        hint — while base and a1 admissions sail through untouched (the
+        acceptance-pinned isolation half)."""
+        eng = budget_engine
+        h0 = eng.submit(PROMPT, adapter="a0", max_new_tokens=4)
+        with pytest.raises(ServerOverloadedError,
+                           match="blocks_exhausted") as ei:
+            eng.submit(PROMPT2, adapter="a0", max_new_tokens=4)
+        assert "THIS tenant" in str(ei.value)
+        assert 50.0 <= ei.value.retry_after_ms <= 30_000.0
+        # The neighbor tenants' doors are open at the same instant.
+        hb = eng.submit(PROMPT2, max_new_tokens=4)
+        h1 = eng.submit(PROMPT2, adapter="a1", max_new_tokens=4)
+        for h in (h0, hb, h1):
+            assert h.result(120)["n_tokens"] == 4
+        snap = eng.stats()
+        assert snap["rejected_blocks_exhausted"] >= 1
+
+    def test_budget_demand_returns_to_zero(self, budget_engine):
+        """All streams done: the door ledger is empty and the pool owns
+        no blocks for the budgeted tenant — a finished stream frees its
+        budget headroom completely."""
+        eng = budget_engine
+        eng.generate(PROMPT, adapter="a0", max_new_tokens=4, timeout=120)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            owned = eng.stats()["blocks_by_tenant"]["owned"]
+            if not eng._tenant_blocks and owned.get("a0", 0) == 0:
+                break
+            time.sleep(0.05)
+        assert not eng._tenant_blocks
+        assert eng.stats()["blocks_by_tenant"]["owned"].get("a0", 0) == 0
+        assert eng.stats()["blocks_by_tenant"]["budgets"] == {"a0": 2}
+        # ...and the tenant can admit again immediately.
+        assert eng.generate(PROMPT, adapter="a0", max_new_tokens=4,
+                            timeout=120)["n_tokens"] == 4
+
+    def test_impossible_request_rejects_eagerly(self, budget_engine):
+        """need_blocks > budget can NEVER be admitted — a ValueError at
+        submit naming the remedy, not an overload to retry forever."""
+        eng = budget_engine
+        eng._blocks.set_budget("a1", 1)
+        try:
+            with pytest.raises(ValueError, match="NEVER"):
+                eng.submit(PROMPT, adapter="a1", max_new_tokens=8)
+        finally:
+            eng._blocks.set_budget("a1", None)
+
+    def test_quota_rejection_carries_retry_hint(self, budget_engine):
+        """tenant_quota rejections hint the same backoff fleet 503s do
+        (the satellite: today-only-overload-hints fixed)."""
+        eng = budget_engine
+        eng.adapters.set_quota("base", 1)
+        try:
+            h0 = eng.submit(PROMPT, max_new_tokens=4)
+            with pytest.raises(ServerOverloadedError,
+                               match="tenant_quota|over quota") as ei:
+                eng.submit(PROMPT2, max_new_tokens=4)
+            assert 50.0 <= ei.value.retry_after_ms <= 30_000.0
+            h0.result(120)
+        finally:
+            eng.adapters.set_quota("base", None)
+
+    def test_budget_validation(self, model):
+        cfg, params = model
+        with pytest.raises(ValueError, match="paged"):
+            serve.GenerationConfig(tenant_block_budgets={"a0": 2})
+        with pytest.raises(ValueError, match=">= 1"):
+            serve.GenerationConfig(kv_layout="paged",
+                                   tenant_block_budgets={"a0": 0})
+        with pytest.raises(ValueError, match="> 0"):
+            serve.GenerationConfig(tenant_weights={"a0": 0.0})
+        with pytest.raises(ValueError, match="> 0"):
+            serve.GenerationConfig(tenant_slo_ttft_ms={"a0": -1.0})
+        with pytest.raises(ValueError, match="preempt_retries"):
+            serve.GenerationConfig(preempt_retries=-1)
+
+
+# -- preempt-by-evict: digest identity --------------------------------------
+
+
+@pytest.fixture(scope="module", params=["contiguous", "paged"])
+def preempt_engine(request, model, lora_setup):
+    """One decode slot, a0 in priority class 1 above base: a pending a0
+    admission always preempts a running base stream. Parametrized over
+    both KV layouts — the envelope capture walks different release
+    paths (slot rows vs block tables)."""
+    cfg, params = model
+    reg = _registry(model, lora_setup)
+    kw = {}
+    if request.param == "paged":
+        kw = dict(kv_layout="paged", block_size=8)
+    eng = _engine(params, cfg, adapters=reg,
+                  tenant_priorities={"a0": 1}, **kw)
+    yield eng
+    eng.shutdown()
+
+
+def _preempt_run(eng, sampling=None):
+    """Submit a long base stream, wait for its first token (it is IN
+    the slot), then submit a priority-1 a0 stream — the base stream is
+    evicted, a0 runs, and base resumes with its emitted prefix replayed
+    suppressed-and-verified. Returns (base result, a0 result)."""
+    kw = {"sampling": sampling} if sampling is not None else {}
+    h = eng.submit(PROMPT, max_new_tokens=40, **kw)
+    kind, _ = h.next_event(timeout=120)
+    assert kind == "token"
+    hp = eng.submit(PROMPT2, adapter="a0", max_new_tokens=4)
+    rp = hp.result(120)
+    rb = h.result(120)
+    assert rp["n_tokens"] == 4
+    return rb, rp
+
+
+class TestPreemption:
+    def test_preempted_stream_is_bit_identical_greedy(self, preempt_engine):
+        """THE digest pin: a preempted-then-resumed stream's tokens are
+        bitwise equal to the same request run uninterrupted — eviction
+        is invisible in the stream, only visible in the counters."""
+        eng = preempt_engine
+        ref = eng.generate(PROMPT, max_new_tokens=40, timeout=120)
+        before = eng.stats()["generation"]
+        rb, _ = _preempt_run(eng)
+        assert rb["tokens"] == ref["tokens"]
+        assert rb["n_tokens"] == ref["n_tokens"]
+        after = eng.stats()["generation"]
+        assert after["preemptions_total"] > before["preemptions_total"]
+        assert (after["preempt_resumed_total"]
+                > before["preempt_resumed_total"])
+        assert (after["preempt_exhausted_total"]
+                == before["preempt_exhausted_total"])
+
+    def test_preempted_stream_is_bit_identical_seeded(self, preempt_engine):
+        """Same pin under seeded sampling: the replay restarts the rng
+        from the seed, so the regenerated prefix consumes identical
+        draws and the suppressed-and-verified catch-up holds."""
+        eng = preempt_engine
+        samp = serve.SamplingParams(temperature=0.9, top_k=5, seed=7)
+        ref = eng.generate(PROMPT, max_new_tokens=40, sampling=samp,
+                           timeout=120)
+        before = eng.stats()["generation"]["preempt_resumed_total"]
+        rb, _ = _preempt_run(eng, sampling=samp)
+        assert rb["tokens"] == ref["tokens"]
+        assert eng.stats()["generation"]["preempt_resumed_total"] > before
+
+    def test_retry_budget_exhaustion_is_terminal(self, model, lora_setup):
+        """preempt_retries=0: the FIRST eviction fails the stream with
+        terminal reason preempted_exhausted (PreemptedError), and the
+        exhausted counter records it."""
+        cfg, params = model
+        reg = _registry(model, lora_setup)
+        eng = _engine(params, cfg, adapters=reg,
+                      tenant_priorities={"a0": 1}, preempt_retries=0)
+        try:
+            h = eng.submit(PROMPT, max_new_tokens=40)
+            kind, _ = h.next_event(timeout=120)
+            assert kind == "token"
+            hp = eng.submit(PROMPT2, adapter="a0", max_new_tokens=4)
+            with pytest.raises(PreemptedError,
+                               match="preempted_exhausted"):
+                h.result(120)
+            assert hp.result(120)["n_tokens"] == 4
+            gen = eng.stats()["generation"]
+            assert gen["preempt_exhausted_total"] == 1
+            assert gen["preemptions_total"] == 1
+        finally:
+            eng.shutdown()
+
+    def test_preempt_off_never_evicts(self, model, lora_setup):
+        """preempt=False: a priority-1 admission waits like anyone else
+        — the running stream keeps its slot."""
+        cfg, params = model
+        reg = _registry(model, lora_setup)
+        eng = _engine(params, cfg, adapters=reg,
+                      tenant_priorities={"a0": 1}, preempt=False,
+                      default_max_new_tokens=8)
+        try:
+            h = eng.submit(PROMPT, max_new_tokens=8)
+            hp = eng.submit(PROMPT2, adapter="a0", max_new_tokens=4)
+            h.result(120)
+            hp.result(120)
+            assert eng.stats()["generation"]["preemptions_total"] == 0
+        finally:
+            eng.shutdown()
+
+
+# -- zero new compiled programs ---------------------------------------------
+
+
+class TestCompileCachePin:
+    def test_scheduler_budgets_preemption_compile_nothing(
+            self, preempt_engine, model, lora_setup):
+        """The acceptance pin: an engine whose traffic exercised fair
+        scheduling, priorities AND a preemption-with-replay holds
+        exactly the compile cache of a neutral FIFO engine with the
+        same geometry — slot assignment and eviction are host-side
+        data, never compile keys."""
+        cfg, params = model
+        eng = preempt_engine          # has preempted + replayed by now
+        reg = _registry(model, lora_setup)
+        kw = {}
+        if eng.stats()["kv_layout"] == "paged":
+            kw = dict(kv_layout="paged", block_size=8)
+        fifo = _engine(params, cfg, adapters=reg, **kw)
+        try:
+            fifo.generate(PROMPT, max_new_tokens=4, timeout=120)
+            fifo.generate(PROMPT2, adapter="a0", max_new_tokens=4,
+                          timeout=120)
+            assert eng.stats()["compiled"] == fifo.stats()["compiled"]
+        finally:
+            fifo.shutdown()
+
+
+# -- held-line deadline expiry ----------------------------------------------
+
+
+class TestHeldDeadline:
+    def test_expired_held_request_releases_its_door_slot(self, model):
+        """A stream whose deadline expires while parked in the held
+        line fails NOW with DeadlineExceededError and hands back its
+        max_queue admission ticket — a dead-on-arrival request must not
+        wedge the door (max_queue=1: a leaked ticket would reject every
+        later submit)."""
+        cfg, params = model
+        eng = _engine(params, cfg, max_queue=1)
+        try:
+            h0 = eng.submit(PROMPT, max_new_tokens=40)
+            kind, _ = h0.next_event(timeout=120)
+            assert kind == "token"
+            h1 = eng.submit(PROMPT2, max_new_tokens=4, deadline_ms=1.0)
+            with pytest.raises(DeadlineExceededError):
+                h1.result(120)
+            deadline = time.monotonic() + 30
+            while (eng._queue.held_count > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert eng._queue.held_count == 0
+            h2 = eng.submit(PROMPT2, max_new_tokens=2)   # door is open
+            assert h2.result(120)["n_tokens"] == 2
+            h0.result(120)
+        finally:
+            eng.shutdown()
+
+
+# -- SLO burn metrics -------------------------------------------------------
+
+
+class TestSloMetrics:
+    def test_burn_counts_misses_over_outcomes(self):
+        m = ServeMetrics()
+        m.on_first_token(100.0, tenant="a0", slo_ms=50.0)    # miss
+        assert m.slo_burn("a0") == 1.0
+        m.on_first_token(10.0, tenant="a0", slo_ms=50.0)     # hit
+        assert m.slo_burn("a0") == 0.5
+        assert m.slo_burn("unknown") == 0.0
+        t = m.snapshot()["tenants"]["a0"]
+        assert t["first_tokens_total"] == 2
+        assert t["ttft_slo_miss_total"] == 1
+        assert t["slo_ttft_target_ms"] == 50.0
+        assert t["slo_burn"] == 0.5
+
+    def test_deadline_miss_is_worst_burn(self):
+        """An expiry never produced a first token: it counts in both
+        halves of the burn fraction."""
+        m = ServeMetrics()
+        m.on_first_token(10.0, tenant="a0", slo_ms=50.0)     # hit
+        m.on_deadline_expired(900.0, tenant="a0")
+        assert m.slo_burn("a0") == 0.5
+        assert m.snapshot()["tenants"]["a0"]["deadline_miss_total"] == 1
+
+    def test_no_slo_no_burn(self):
+        m = ServeMetrics()
+        m.on_first_token(1e9, tenant="a0")                   # no target
+        assert m.slo_burn("a0") == 0.0
+
+    def test_slo_series_in_exposition(self):
+        m = ServeMetrics()
+        m.on_first_token(100.0, tenant="a0", slo_ms=50.0)
+        text = m.registry.render()
+        assert 'hvd_tenant_slo_ttft_miss_total{tenant="a0"} 1' in text
+        assert "hvd_tenant_slo_burn" in text
+        assert "hvd_tenant_slo_ttft_target_ms" in text
+
+    def test_preempt_outcome_validation(self):
+        m = ServeMetrics()
+        m.on_preempt("evicted", tenant="a0")
+        m.on_preempt("resumed")
+        m.on_preempt("exhausted")
+        with pytest.raises(ValueError, match="outcome"):
+            m.on_preempt("vanished")
+        snap = m.snapshot()["generation"]
+        assert snap["preemptions_total"] == 1
+        assert snap["preempt_resumed_total"] == 1
+        assert snap["preempt_exhausted_total"] == 1
+        assert m.snapshot()["tenants"]["a0"]["preemptions_total"] == 1
+
+    def test_retry_after_clamped(self):
+        m = ServeMetrics()
+        assert m.retry_after_ms(0) == 1000.0    # no rate measured yet
+        m.on_response(1.0, 0.0)
+        assert 50.0 <= m.retry_after_ms(0) <= 30_000.0
+        assert m.retry_after_ms(10 ** 9) == 30_000.0
+
+
+# -- SLO-aware fleet dispatch (fake engines) --------------------------------
+
+
+class _FakeEngine(ReadinessMixin):
+    def __init__(self, load=0, burn=None, tenants=None):
+        self._queue = []
+        self._warmed = True
+        self._load = load
+        self._burn = burn or {}       # tenant -> burn fraction
+        self._tenants = tenants or {}
+        self.submits = []
+
+    def load(self):
+        return self._load
+
+    def slo_burn(self, tenant):
+        return self._burn.get(tenant, 0.0)
+
+    def submit(self, *a, **kw):
+        self.submits.append((a, kw))
+        return "accepted"
+
+    def warmup(self):
+        self._warmed = True
+
+    def shutdown(self, drain=True, timeout=None):
+        pass
+
+    def stats(self):
+        return {"requests_total": len(self.submits), "queue_depth": 0,
+                **({"tenants": self._tenants} if self._tenants else {})}
+
+
+class TestFleetSloDispatch:
+    def test_burning_replica_sorts_after_clean_peer(self):
+        """Equal load, r0 burning the base tenant's SLO: dispatch goes
+        to the clean replica."""
+        burning = _FakeEngine(load=0, burn={"base": 0.5})
+        clean = _FakeEngine(load=0)
+        router = FleetRouter(engines=[burning, clean])
+        try:
+            assert router.submit("x") == "accepted"
+            assert clean.submits and not burning.submits
+        finally:
+            router.shutdown()
+
+    def test_burn_is_per_tenant(self):
+        """r0 burns only tenant a9's SLO — base traffic still lands on
+        it by load; engines without slo_burn sort as not-burning."""
+        r0 = _FakeEngine(load=0, burn={"a9": 1.0})
+        r1 = _FakeEngine(load=5)
+        router = FleetRouter(engines=[r0, r1])
+        try:
+            router.submit("x")
+            assert r0.submits                   # base: load decides
+        finally:
+            router.shutdown()
+
+    def test_burning_still_beats_nothing(self):
+        """Every ready replica burning: traffic still flows (the key
+        reorders, it never rejects)."""
+        r0 = _FakeEngine(load=0, burn={"base": 1.0})
+        router = FleetRouter(engines=[r0])
+        try:
+            assert router.submit("x") == "accepted"
+        finally:
+            router.shutdown()
+
+    def test_fleet_stats_recompute_slo_burn(self):
+        """Fleet /stats sums the per-tenant SLO counters across
+        replicas and RECOMPUTES the burn from the sums (never averages
+        per-replica fractions), and surfaces burning tenants in the
+        fleet block."""
+        t0 = {"a0": {"generations_total": 10, "tokens_generated_total": 40,
+                     "first_tokens_total": 9, "ttft_slo_miss_total": 0,
+                     "deadline_miss_total": 1, "preemptions_total": 2}}
+        t1 = {"a0": {"generations_total": 90, "tokens_generated_total": 360,
+                     "first_tokens_total": 90, "ttft_slo_miss_total": 0,
+                     "deadline_miss_total": 0, "preemptions_total": 0}}
+        router = FleetRouter(engines=[_FakeEngine(tenants=t0),
+                                      _FakeEngine(tenants=t1)])
+        try:
+            snap = router.stats()
+            agg = snap["tenants"]["a0"]
+            assert agg["first_tokens_total"] == 99
+            assert agg["deadline_miss_total"] == 1
+            assert agg["preemptions_total"] == 2
+            # burn = (0 misses + 1 expiry) / (99 + 1) outcomes — the
+            # replica-averaged number would be 0.05, not 0.01.
+            assert agg["slo_burn"] == pytest.approx(0.01)
+            assert snap["fleet"]["slo_burning"] == {
+                "a0": pytest.approx(0.01)}
+        finally:
+            router.shutdown()
