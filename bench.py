@@ -428,12 +428,15 @@ def _lm_config():
 
 def measure_lm(cfg=None) -> float:
     """Tokens/sec of the compiled transformer-LM train step over all
-    visible devices — a pure dp mesh by default, or dp×tp with
+    visible devices — a pure dp mesh by default, dp×tp with
     ``cfg["tp"] > 1`` (the hybrid plane: Megatron-sharded weights, batch
-    over dp; ISSUE 8). Returns total (not per-chip) throughput.
-    Single-controller only: the parallel transformer's mesh covers this
-    process's devices, so an env-world run would train unsynced local
-    replicas and report a meaningless rate."""
+    over dp; ISSUE 8), or the full 3-D dp×tp×pp mesh with
+    ``cfg["pp"] > 1`` (the pipelined family: 1F1B schedule, gradient
+    sync interpreted from the unified spec-grouped plan; ISSUE 20).
+    Returns total (not per-chip) throughput. Single-controller only: the
+    parallel transformer's mesh covers this process's devices, so an
+    env-world run would train unsynced local replicas and report a
+    meaningless rate."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from horovod_tpu.parallel.mesh import create_hybrid_mesh
     from horovod_tpu.parallel.transformer import (
@@ -453,17 +456,19 @@ def measure_lm(cfg=None) -> float:
     devs = jax.devices()
     n = len(devs)
     tp = int(cfg.get("tp", 1))
-    if tp < 1 or n % tp:
+    pp = int(cfg.get("pp", 1))
+    if tp < 1 or pp < 1 or n % (tp * pp):
         raise SystemExit(
-            f"--tp {tp} must divide the visible device count {n} "
-            f"(the mesh is dp={n}//tp × tp)")
-    dp = n // tp
+            f"--tp {tp} × --pp {pp} must divide the visible device count "
+            f"{n} (the mesh is dp={n}//(tp·pp) × tp × pp)")
+    dp = n // (tp * pp)
     want_dp = cfg.get("mesh_dp")
     if want_dp is not None and int(want_dp) != dp:
         raise SystemExit(
-            f"--mesh dp={want_dp},tp={tp} does not match the visible "
-            f"device count {n} (needs dp×tp == devices; dp here is {dp})")
-    mesh = create_hybrid_mesh(dp=dp, tp=tp)
+            f"--mesh dp={want_dp},tp={tp},pp={pp} does not match the "
+            f"visible device count {n} (needs dp×tp×pp == devices; dp "
+            f"here is {dp})")
+    mesh = create_hybrid_mesh(dp=dp, tp=tp, pp=pp)
     tcfg = TransformerConfig(
         vocab=cfg["vocab"], d_model=cfg["d_model"], n_heads=cfg["n_heads"],
         n_layers=cfg["n_layers"], d_ff=cfg["d_ff"], dtype=jnp.bfloat16,
@@ -471,11 +476,34 @@ def measure_lm(cfg=None) -> float:
         unembed_dtype=jnp.bfloat16, remat=bool(cfg.get("remat", False)),
         loss_chunk=int(cfg.get("loss_chunk", 0)))
     opt = optax.adamw(1e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    init_state, step = make_parallel_train_step(
-        tcfg, mesh, opt, wire_dtype=cfg.get("wire_dtype"),
-        zero=bool(cfg.get("zero", False)),
-        overlap=True if cfg.get("overlap") else None,
-        accum_steps=int(cfg.get("accum_steps", 1)))
+    if pp > 1:
+        from horovod_tpu.parallel.pp_transformer import (
+            make_pp_transformer_train_step)
+        if cfg["n_layers"] % pp:
+            raise SystemExit(
+                f"--pp {pp} must divide n_layers={cfg['n_layers']} (each "
+                f"pipeline stage owns n_layers//pp layers)")
+        # Accumulation is NATIVE in the pipelined family — microbatches
+        # ARE the accumulation, one planned exchange per optimizer step —
+        # so --accum-steps sets the microbatch count (min 2: a 1-deep
+        # pipeline is all bubble).
+        micro = max(2, int(cfg.get("accum_steps", 1)))
+        if cfg["batch_per_chip"] % micro:
+            raise SystemExit(
+                f"batch_per_chip={cfg['batch_per_chip']} must divide into "
+                f"--accum-steps {micro} microbatches for the pipelined "
+                f"path")
+        init_state, step = make_pp_transformer_train_step(
+            tcfg, mesh, opt, n_microbatches=micro,
+            wire_dtype=cfg.get("wire_dtype"),
+            zero=bool(cfg.get("zero", False)),
+            overlap=True if cfg.get("overlap") else None)
+    else:
+        init_state, step = make_parallel_train_step(
+            tcfg, mesh, opt, wire_dtype=cfg.get("wire_dtype"),
+            zero=bool(cfg.get("zero", False)),
+            overlap=True if cfg.get("overlap") else None,
+            accum_steps=int(cfg.get("accum_steps", 1)))
     params, opt_state = init_state(jax.random.PRNGKey(0))
 
     # tp ranks within a dp group replicate the same rows, so the global
@@ -526,12 +554,13 @@ def measure_lm(cfg=None) -> float:
     return rate
 
 
-def _mesh_desc(n: int, tp: int) -> str:
-    dp = n // max(1, tp)
-    return f"dp{dp}" + (f",tp{tp}" if tp > 1 else "")
+def _mesh_desc(n: int, tp: int, pp: int = 1) -> str:
+    dp = n // (max(1, tp) * max(1, pp))
+    return (f"dp{dp}" + (f",tp{tp}" if tp > 1 else "")
+            + (f",pp{pp}" if pp > 1 else ""))
 
 
-def lm_line(wire_dtype=None, tp: int = 1, zero: bool = False,
+def lm_line(wire_dtype=None, tp: int = 1, pp: int = 1, zero: bool = False,
             overlap: bool = False, accum_steps: int = 1,
             mesh_dp=None) -> dict:
     from horovod_tpu.ops.fusion import wire_dtype_name
@@ -539,6 +568,7 @@ def lm_line(wire_dtype=None, tp: int = 1, zero: bool = False,
     if wire_dtype:
         cfg["wire_dtype"] = wire_dtype
     cfg["tp"] = tp
+    cfg["pp"] = pp
     cfg["zero"] = zero
     cfg["overlap"] = overlap
     cfg["accum_steps"] = accum_steps
@@ -570,7 +600,11 @@ def lm_line(wire_dtype=None, tp: int = 1, zero: bool = False,
         "overlap": bool(overlap),
         "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
         "tp": int(tp),
-        "mesh": _mesh_desc(n, tp),
+        "pp": int(pp),
+        # The bench LM carries no experts; the field still appears so a
+        # future MoE measurement is distinguishable from these lines.
+        "ep": 1,
+        "mesh": _mesh_desc(n, tp, pp),
     }
     # The hybrid HBM win (weights + opt state ÷ tp, opt state ÷ dp with
     # --zero) is only claimable if the line carries the number.
@@ -631,35 +665,51 @@ def main() -> None:
                         "weights over tp, batch over dp=devices//tp; "
                         "docs/performance.md 'Hybrid dp×tp'); recorded "
                         "in every JSON line alongside 'mesh'")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel axis size for the 3-D dp×tp×pp "
+                        "mesh (transformer_lm only: 1F1B schedule, stage-"
+                        "owned weights, gradient sync from the unified "
+                        "spec-grouped plan; docs/performance.md 'One "
+                        "plan, every plane'); recorded in every JSON "
+                        "line alongside 'mesh'")
     p.add_argument("--mesh", default=None,
-                   help="explicit mesh spec 'dp=N,tp=M' (must multiply "
-                        "to the visible device count); equivalent to "
-                        "--tp M with a dp sanity check")
+                   help="explicit mesh spec 'dp=N,tp=M,pp=P' (must "
+                        "multiply to the visible device count); "
+                        "equivalent to --tp M --pp P with a dp sanity "
+                        "check")
     args = p.parse_args()
     if args.accum_steps < 1:
         raise SystemExit(f"--accum-steps must be >= 1, got "
                          f"{args.accum_steps}")
     tp = args.tp
+    pp = args.pp
     mesh_dp = None
     if args.mesh:
         import re as _re
         sizes = {}
         for part in args.mesh.split(","):
-            m = _re.match(r"^\s*(dp|tp)\s*=?\s*(\d+)\s*$", part)
+            m = _re.match(r"^\s*(dp|tp|pp)\s*=?\s*(\d+)\s*$", part)
             if not m:
                 raise SystemExit(
-                    f"--mesh expects 'dp=N,tp=M' (got {part!r}); axes "
-                    f"beyond dp/tp are examples/transformer_lm.py "
-                    f"territory")
+                    f"--mesh expects 'dp=N,tp=M,pp=P' (got {part!r}); "
+                    f"axes beyond dp/tp/pp are "
+                    f"examples/transformer_lm.py territory")
             sizes[m.group(1)] = int(m.group(2))
         mtp = sizes.get("tp", 1)
         if tp != 1 and tp != mtp:
             raise SystemExit(
                 f"--tp {tp} conflicts with --mesh {args.mesh!r}")
         tp = mtp
+        mpp = sizes.get("pp", 1)
+        if pp != 1 and pp != mpp:
+            raise SystemExit(
+                f"--pp {pp} conflicts with --mesh {args.mesh!r}")
+        pp = mpp
         mesh_dp = sizes.get("dp")
     if tp < 1:
         raise SystemExit(f"--tp must be >= 1, got {tp}")
+    if pp < 1:
+        raise SystemExit(f"--pp must be >= 1, got {pp}")
     if args.model == "transformer_lm":
         if args.scaling:
             raise SystemExit(
@@ -667,16 +717,17 @@ def main() -> None:
                 "family's re-init-with-device-subsets machinery does not "
                 "apply); run it without --scaling")
         print(json.dumps(lm_line(
-            wire_dtype=args.wire_dtype, tp=tp, zero=bool(args.zero),
-            overlap=bool(args.overlap), accum_steps=args.accum_steps,
-            mesh_dp=mesh_dp)))
+            wire_dtype=args.wire_dtype, tp=tp, pp=pp,
+            zero=bool(args.zero), overlap=bool(args.overlap),
+            accum_steps=args.accum_steps, mesh_dp=mesh_dp)))
         return
-    if tp > 1:
+    if tp > 1 or pp > 1:
         raise SystemExit(
-            "--tp/--mesh tp>1 applies to --model transformer_lm (the "
-            "hybrid dp×tp workload): the conv family's flax models are "
-            "not tensor-sharded — a silent ignore would mislabel a pure-"
-            "dp run as a hybrid measurement")
+            "--tp/--pp/--mesh beyond pure dp applies to --model "
+            "transformer_lm (the hybrid and pipelined workloads): the "
+            "conv family's flax models are neither tensor-sharded nor "
+            "staged — a silent ignore would mislabel a pure-dp run as a "
+            "multi-axis measurement")
     cfg = _bench_config(args.model or "resnet50")
     cfg["accum_steps"] = args.accum_steps
     cfg["zero"] = bool(args.zero)
@@ -704,10 +755,11 @@ def main() -> None:
             "zero": bool(cfg.get("zero", False)),
             "overlap": bool(cfg.get("overlap", False)),
             "wire_dtype": wire_dtype_name(cfg.get("wire_dtype")),
-            # The conv family is pure dp (flax models are not tensor-
-            # sharded); the fields still appear so every JSON line is
-            # mesh-attributable.
+            # The conv family is pure dp (flax models are neither
+            # tensor-sharded nor staged); the fields still appear so
+            # every JSON line is mesh-attributable.
             "tp": 1,
+            "pp": 1,
             "mesh": _mesh_desc(hvd.size(), 1),
         }
 

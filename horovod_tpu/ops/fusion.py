@@ -485,6 +485,31 @@ def plan_grad_sync(specs: Sequence[Any], mesh,
     return out
 
 
+def plan_exchange(leaves: Sequence[Any], *, world_size: int,
+                  axis_name: str = AXIS,
+                  fusion_threshold: Optional[int] = None):
+    """The host-plane (env-world) view of the gradient-sync plan: the
+    SAME :class:`GradSync` data the compiled executors interpret,
+    specialized to the coordinator's 1-D world. Every rank computes a
+    full local gradient — every leaf is replicated across the whole
+    world — so each leaf's decision is
+    ``GradSync(psum=(axis_name,), shard=(), denom=world_size)`` and
+    bucket membership comes from the same fusion scan
+    (:func:`plan_buckets` with the sync as the group key; one group, so
+    the scan degrades to the classic dtype+threshold walk and existing
+    bucket layouts are unchanged). Returns ``(buckets, syncs)``.
+
+    One planner, two executors: the compiled plane realizes a sync with
+    ``lax.psum`` + a ``1/denom`` prescale; the host executor realizes
+    the identical denominator through the coordinator's AVERAGE op (an
+    explicit post-scale if a future planner's denom ever disagrees with
+    the world size) — membership and denominators can never drift
+    between the two because both read this object."""
+    syncs = [GradSync(psum=(axis_name,), shard=(),
+                      denom=int(world_size)) for _ in leaves]
+    return plan_buckets(leaves, fusion_threshold, groups=syncs), syncs
+
+
 def _grouped_allreduce(leaves, treedef, syncs: Sequence[GradSync],
                        fusion_threshold, prescale, return_finite, wire,
                        overlap_on: bool, grad_order):

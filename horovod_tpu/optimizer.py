@@ -34,6 +34,7 @@ checkpoints restore with plain optax, without this framework installed.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -44,9 +45,9 @@ import optax
 from . import runtime
 from .ops.collectives import broadcast as _broadcast
 from .ops.fusion import (ZeroPlan, fused_allgather_params, fused_allreduce,
-                         fused_reduce_scatter, plan_grad_sync, plan_zero,
-                         resolve_wire_dtype, shard_params, wire_dtype_name,
-                         zero_emit_order, zero_stack_global,
+                         fused_reduce_scatter, plan_exchange, plan_grad_sync,
+                         plan_zero, resolve_wire_dtype, shard_params,
+                         wire_dtype_name, zero_emit_order, zero_stack_global,
                          zero_stacked_spec, zero_unstack_global)
 from .runtime import AXIS
 from .ops.sparse import IndexedSlices, allreduce_indexed_slices
@@ -566,6 +567,11 @@ def _hybrid_allreduce_optimizer(optimizer, *, mesh, param_specs, skip_axes,
     update_fn.param_specs = param_specs
     update_fn.skip_axes = tuple(skip_axes)
     update_fn.hybrid = True
+    # Uniform stamp with the 1-D plane: a host-plane executor driving
+    # this optimizer reads the same planner (the hybrid ICI executor
+    # builds its richer spec-grouped syncs in update_fn itself).
+    update_fn.exchange_plan = functools.partial(
+        plan_exchange, fusion_threshold=fusion_threshold)
     # The step builder derives opt-state PartitionSpecs by mapping the
     # param specs over the state with optax.tree_map_params — that needs
     # the WRAPPED transformation (this wrapper's init would device_put
@@ -769,6 +775,13 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     update_fn.wire_dtype = wire_dtype_name(wire)
     update_fn.overlap = overlap
     update_fn.supports_grad_order = True
+    # The env-world executor interprets THIS plan (one planner, two
+    # executors): same membership and denominators as the compiled
+    # fused-allreduce, carried by the stamped optimizer so the two planes
+    # cannot drift (the ZeRO state carries its ZeroPlan the same way).
+    update_fn.exchange_plan = functools.partial(
+        plan_exchange, axis_name=axis_name,
+        fusion_threshold=fusion_threshold)
     return optax.GradientTransformation(init_fn, update_fn)
 
 

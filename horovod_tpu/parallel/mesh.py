@@ -37,6 +37,13 @@ def create_hybrid_mesh(dp: int = 1, tp: int = 1, pp: int = 1, sp: int = 1,
     fastest so they land on ICI-adjacent chips; dp is outermost so its
     collectives can ride DCN across hosts ("How to Scale Your Model" mesh
     recipe).
+
+    Every axis feeds the same spec-grouped gradient-sync plan
+    (``ops/fusion.plan_grad_sync``): a leaf psums over exactly the axes
+    it is replicated across, so growing the mesh — 3-D dp×tp×pp for the
+    pipelined family, ``ep`` for MoE experts — changes PartitionSpecs,
+    never step-body collective code (parity-pinned in
+    tests/test_parallel.py).
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     sizes = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
@@ -98,9 +105,13 @@ def named_sharding_tree(mesh: Mesh, tree, spec_fn=None):
 def grad_sync_by_spec(grads, specs, mesh_axes, *, skip_axes=(),
                       wire_dtype=None):
     """Gradient sync for spec-sharded parameter trees (runs INSIDE
-    shard_map). One implementation shared by both transformer families —
-    the collective-gradient math is subtle enough that duplicating it is
-    how bugs multiply.
+    shard_map). The per-leaf EMPIRICAL REFERENCE of the sync rule: every
+    production plane now interprets the fused spec-grouped plan
+    (``ops/fusion.plan_grad_sync`` → ``GradSync``, one collective per
+    reduce-axis group) instead of calling this walk, but this function
+    remains the ground truth the plan's membership and denominators are
+    parity-pinned against in tests — the collective-gradient math is
+    subtle enough that an executable reference is how drift gets caught.
 
     Each leaf's gradient is averaged (``pmean``) over every mesh axis the
     leaf is REPLICATED across (all axes not in its own PartitionSpec and

@@ -7,6 +7,16 @@ shapes — overflow tokens beyond capacity drop, standard MoE behavior),
 exchanged with a single ``all_to_all`` so chip e receives every chip's
 tokens for expert e, transformed by the local expert FFN, and returned by
 the inverse ``all_to_all``; gate probabilities weight the combine.
+
+The expert plane is a first-class mesh axis, not a side channel:
+``create_hybrid_mesh(ep=E)`` names it, expert weights carry ``ep`` in
+their PartitionSpecs (``parallel/transformer.py`` puts ``P('ep', …)`` on
+w1/w2 when ``n_experts`` is set), and their gradients ride the SAME
+spec-grouped collective plan as every other leaf
+(``ops/fusion.plan_grad_sync``: expert grads psum over the axes they are
+replicated across — never ``ep``, each rank owns its expert — while the
+replicated gate syncs over the full mesh). No MoE-specific gradient code
+exists anywhere.
 """
 
 from __future__ import annotations
@@ -31,6 +41,10 @@ def moe_ffn(x, gate_w, w1, w2, *, axis_name: str = "ep",
     Returns ([T_local, D], aux_loss) — aux_loss is the load-balancing loss
     (mean over experts of fraction_routed · mean_gate_prob · E²).
     """
+    if capacity_factor <= 0:
+        raise ValueError(
+            f"capacity_factor must be > 0, got {capacity_factor} — a "
+            f"non-positive capacity would silently drop every token")
     T, D = x.shape
     E = lax.axis_size(axis_name)
     C = max(1, int((T / E) * capacity_factor + 0.999))

@@ -16,6 +16,13 @@ into S stages, one per pp rank; activations flow stage-to-stage with
   its VJP), so activation memory is O(S) microbatches per stage instead of
   O(M) — the property that makes pipeline training usable when M is large.
   Compute is the same ~3 forwards/microbatch as gpipe-under-remat.
+
+Both schedules are gradient-sync-free by design: they move activations
+and cotangents (``ppermute``), never gradients. The caller owns the
+exchange — the pipelined transformer interprets the unified spec-grouped
+collective plan for it (``parallel/pp_transformer.py``, ISSUE 20) — so
+the schedule composes unchanged on the full 3-D dp×tp×pp mesh
+(parity-pinned against the dp-only reference in tests/test_parallel.py).
 """
 
 from __future__ import annotations

@@ -13,6 +13,15 @@ the pipeline so every stage runs the same uniform block structure (the
 lockstep-SPMD requirement): the embedding's gradient is assembled from the
 head's unembed contribution (last pp rank) plus the input-side cotangents
 (pp rank 0) that `one_f_one_b` returns — summed with one `psum` over pp.
+
+Gradient sync is the unified spec-grouped collective plan (ISSUE 20): the
+step interprets the same `GradSync`/`ZeroPlan` data every other plane does
+(`DistributedOptimizer(mesh=, param_specs=)` → `plan_grad_sync` →
+`fused_allreduce(reduce_axes=)`), with `pp` excluded from every allreduce
+reduce set — each stage owns its weights. The per-leaf
+`grad_sync_by_spec` walk this file used to run stays exported from
+`parallel.mesh` as the empirical reference the plan's denominators are
+pinned against in tests.
 """
 
 from __future__ import annotations
@@ -24,6 +33,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas_attention import flash_attention
+# Re-exported reference (not called in the step body): the per-leaf
+# empirical sync rule the fused GradSync plan is parity-pinned against.
+from .mesh import grad_sync_by_spec  # noqa: F401
 from .pipeline import one_f_one_b
 from .transformer import TransformerConfig, _rms_norm, dense_nll
 
@@ -79,14 +91,51 @@ def pp_param_specs(mesh: Mesh) -> dict:
 
 def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
                                    optimizer: optax.GradientTransformation,
-                                   n_microbatches: int):
+                                   n_microbatches: int,
+                                   *,
+                                   zero: bool = False,
+                                   wire_dtype=None,
+                                   overlap=None,
+                                   guard_nonfinite=None,
+                                   fusion_threshold=None):
     """Build ``(init_state, step)`` for the pipelined transformer.
 
     ``step(params, opt_state, tokens, labels)`` runs one 1F1B update and
     returns ``(params, opt_state, loss)``; tokens/labels are global
     [B, T] int32 sharded over dp, with B divisible by
     dp_size * n_microbatches.
+
+    Gradient sync interprets the unified spec-grouped collective plan:
+    leaves fuse only within their reduce-axis group
+    (:func:`~horovod_tpu.ops.fusion.plan_grad_sync` keyed by
+    :func:`pp_param_specs`, ``pp`` excluded — each stage owns its
+    weights), so on a (dp, pp, tp) mesh the default plan carries TWO
+    bucket collectives (replicated head/norm leaves psum over (dp, tp);
+    tp-sharded matrices over dp with the psum-transpose correction in the
+    bucket prescale) instead of one per leaf. Same composition matrix as
+    the core stack:
+
+    * ``zero=True`` — ZeRO-1 over dp: the spec-grouped ``ZeroPlan`` with
+      pp riding as a real shard axis of the stacked state (stage leaves
+      shard over (pp, tp); the head leaves take the full (dp, pp, tp)
+      reduce, numerically equal to the pp-skip mean because the step's
+      explicit pp psum already made them pp-identical).
+    * ``wire_dtype=`` — bf16/fp8 bucket wire, fp32 scales + accumulation.
+    * ``overlap=`` — barrier-chained per-bucket emission; the 1F1B scan
+      hides backward-completion order from the probe, so emission runs in
+      plan order (reorder-free, still unmergeable by XLA's combiner).
+    * ``guard_nonfinite=`` (default ``HVD_GUARD_NONFINITE``) — skip-step
+      guard; the allreduce plan never reduces over pp, so the verdict is
+      folded with ONE scalar pmin over pp — the only collective the guard
+      adds here (the ZeRO plan's flags already fold over its nonscatter
+      axes).
+    * accum — native: ``n_microbatches`` IS the accumulation shape (1F1B
+      sums M microbatch gradients before the one exchange); there is no
+      separate accum_steps knob to double-divide with.
     """
+    from ..optimizer import DistributedOptimizer
+    from ..utils import config as _config
+
     axes = _axes(mesh)
     if "pp" not in axes:
         raise ValueError("mesh must have a 'pp' axis")
@@ -100,6 +149,16 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
     M = n_microbatches
     specs = pp_param_specs(mesh)
     batch_spec = P("dp" if "dp" in axes else None, None)
+    if guard_nonfinite is None:
+        guard_nonfinite = _config.guard_nonfinite()
+    # The allreduce plan skips pp (stage weights are never replicated
+    # across it); the ZeRO plan instead carries pp as a shard axis — the
+    # stacked [dp, ns·shard_len] state layout must tile over every mesh
+    # axis the stage weights are actually split across.
+    dist_opt = DistributedOptimizer(
+        optimizer, zero=zero, wire_dtype=wire_dtype, overlap=overlap,
+        fusion_threshold=fusion_threshold, mesh=mesh, param_specs=specs,
+        skip_axes=() if zero else ("pp",))
 
     def _block(layer_i, stage_leaves, x):
         """One transformer block (pre-norm attention + FFN) from the
@@ -175,40 +234,55 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
 
         grads = {"embed": d_embed, "lnf": hg["lnf"], "stages": sg}
 
-        # Shared spec-driven sync (see parallel/mesh.py): pmean over each
-        # leaf's replicated axes (never pp — each stage owns its weights)
-        # + the tp psum-transpose correction.
-        from .mesh import grad_sync_by_spec
-        grads = grad_sync_by_spec(grads, specs, axes, skip_axes=("pp",))
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        # One plan, every plane: the spec-grouped GradSync/ZeroPlan
+        # interpretation replaces the old per-leaf grad_sync_by_spec walk
+        # — same denominators (parity-pinned against it in tests), fused
+        # buckets, one collective per spec group.
+        finite_out = {} if guard_nonfinite else None
+        upd_kw = {} if finite_out is None else {"finite_out": finite_out}
+        updates, new_opt_state = dist_opt.update(
+            grads, opt_state, params, **upd_kw)
+        new_params = optax.apply_updates(params, updates)
+        if finite_out is not None:
+            all_finite = finite_out["all_finite"]
+            if not zero:
+                # The allreduce plan never reduces over pp, so per-stage
+                # verdicts must fold once for a mesh-wide skip decision
+                # (divergent decisions would corrupt the pp-replicated
+                # head leaves).
+                all_finite = lax.pmin(
+                    all_finite.astype(jnp.int32), "pp") > 0
+
+            def _keep(new, old):
+                return jnp.where(all_finite, new, old)
+            new_params = jax.tree_util.tree_map(_keep, new_params, params)
+            new_opt_state = jax.tree_util.tree_map(
+                _keep, new_opt_state, opt_state)
+            loss = jnp.where(all_finite, loss, jnp.zeros_like(loss))
+        params, opt_state = new_params, new_opt_state
         loss = lax.pmean(loss, tuple(a for a in axes if a != "pp"))
         return params, opt_state, loss
 
     def _opt_specs(opt_state):
         # Derivable from any opt_state with the right STRUCTURE, so the
         # checkpoint-restore path (params/opt_state from disk, init_state
-        # never called) works too.
-        return optax.tree_map_params(
-            optimizer, lambda _, s: s, opt_state, specs,
-            transform_non_params=lambda _: P())
+        # never called) works too; handles both the mirrored replicated
+        # state and the ZeRO stacked-shard layout.
+        from .. import training
+        return training._hybrid_opt_specs(dist_opt, opt_state, specs)
 
     def init_state(rng):
         params = init_pp_params(rng, cfg, S)
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
             params, specs, is_leaf=lambda x: isinstance(x, P))
-        opt_state = optimizer.init(params)
-        opt_state = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(jnp.asarray(x),
-                                        NamedSharding(mesh, s)),
-            opt_state, _opt_specs(opt_state),
-            is_leaf=lambda x: isinstance(x, P))
-        return params, opt_state
+        # dist_opt.init commits the state to the mesh itself (param specs
+        # mirrored leaf-for-leaf; ZeRO stacks + dp-shards per the plan).
+        return params, dist_opt.init(params)
 
     fn_box = {}
 
-    def step(params, opt_state, tokens, labels):
+    def _jitted(opt_state):
         if "fn" not in fn_box:
             ospecs = _opt_specs(opt_state)
             fn_box["fn"] = jax.jit(jax.shard_map(
@@ -216,6 +290,13 @@ def make_pp_transformer_train_step(cfg: TransformerConfig, mesh: Mesh,
                 in_specs=(specs, ospecs, batch_spec, batch_spec),
                 out_specs=(specs, ospecs, P()),
                 check_vma=False))
-        return fn_box["fn"](params, opt_state, tokens, labels)
+        return fn_box["fn"]
+
+    def step(params, opt_state, tokens, labels):
+        return _jitted(opt_state)(params, opt_state, tokens, labels)
+
+    # AOT handle (jax .lower convention) for HLO-pinned tests.
+    step.lower = lambda params, opt_state, tokens, labels: _jitted(
+        opt_state).lower(params, opt_state, tokens, labels)
 
     return init_state, step

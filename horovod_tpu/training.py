@@ -886,10 +886,16 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                          zero: bool = False):
     """Env-world train step: jit(grads) → host fused allreduce → jit(apply).
 
-    The host gradient exchange uses the same fusion bucketing as the
-    compiled path (``plan_buckets``: 64 MiB / same-dtype / order-preserving,
-    ``HOROVOD_FUSION_THRESHOLD``), so the reference's tensor-fusion contract
-    (``docs/tensor-fusion.md``) holds for this plane too. ``accum_steps``
+    The host gradient exchange INTERPRETS the gradient-sync plan stamped
+    on the optimizer (``dist_opt.update.exchange_plan`` →
+    :func:`~horovod_tpu.ops.fusion.plan_exchange`): the same ``GradSync``
+    data the compiled executors read, so bucket membership and averaging
+    denominators can never drift between the ICI-psum and
+    coordinator-wire executors — one planner, two executors. Membership
+    follows the same fusion scan as the compiled path (64 MiB /
+    same-dtype / order-preserving, ``HOROVOD_FUSION_THRESHOLD``), so the
+    reference's tensor-fusion contract (``docs/tensor-fusion.md``) holds
+    for this plane too. ``accum_steps``
     scans microbatches inside the jitted gradient half exactly like the
     single-controller step, and the per-step host round trip count is
     unchanged — the accumulated tree rides one fused exchange, which is the
@@ -914,11 +920,15 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
     decision — a skipped step discards the speculative shard update and
     keeps opt state bit-unchanged.
     """
-    from .ops.fusion import plan_buckets
+    from .ops.fusion import plan_exchange
 
     w = runtime.world()
     vag = _build_value_and_grad(model, loss_fn, remat)
     wire_np = _env_wire_np(dist_opt)
+    # The stamped planner (DistributedOptimizer carries it); a plain
+    # optax optimizer falls back to the same planner at default knobs.
+    exchange_plan = getattr(dist_opt.update, "exchange_plan", None) \
+        or plan_exchange
 
     def _grads(state: TrainState, inputs, labels):
         step_rng = jax.random.fold_in(
@@ -985,11 +995,17 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         counter["n"] += 1
         tag = counter["n"]
-        buckets = plan_buckets(leaves)
+        # Interpret the stamped GradSync plan: membership AND
+        # denominators come from the one planner the compiled executors
+        # read. The coordinator's AVERAGE op realizes denom == world
+        # size; any other denominator rides an explicit post-scale.
+        buckets, syncs = exchange_plan(leaves, world_size=w.size)
         handles = []
         wire_origs = []
+        post_scale = []
         xbytes = 0
         for bi, bucket in enumerate(buckets):
+            sync = syncs[bucket[0]]
             if len(bucket) == 1:
                 payload = np.asarray(leaves[bucket[0]])
             else:
@@ -997,9 +1013,14 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                     [np.ravel(np.asarray(leaves[j])) for j in bucket])
             payload, orig = _env_wire_cast(payload, wire_np)
             wire_origs.append(orig)
+            if sync.denom == w.size:
+                op, scale = Op.AVERAGE, None
+            else:
+                op, scale = Op.SUM, 1.0 / sync.denom
+            post_scale.append(scale)
             xbytes += payload.nbytes
             handles.append(w.coord.submit(
-                "allreduce", payload, f"grad.{tag}.{bi}", op=Op.AVERAGE))
+                "allreduce", payload, f"grad.{tag}.{bi}", op=op))
         metric_handles = {"loss": w.coord.submit(
             "allreduce", np.asarray(loss, np.float32),
             f"metric.loss.{tag}", op=Op.AVERAGE)}
@@ -1018,6 +1039,10 @@ def _make_env_world_step(model, dist_opt, loss_fn, mesh, axis_name,
                 # reduced the bf16 payload in f32 and narrowed once; the
                 # gradient tree downstream stays in its original dtype.
                 out = out.astype(wire_origs[bi])
+            if post_scale[bi] is not None:
+                # The plan's denominator, when the coordinator's AVERAGE
+                # couldn't realize it directly.
+                out = out * np.asarray(post_scale[bi], out.dtype)
             if guard_nonfinite and np.issubdtype(out.dtype, np.inexact):
                 # Checked while still flat — one pass per REDUCED bucket,
                 # mirroring the compiled plane's in-trace check. The

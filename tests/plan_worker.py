@@ -1,0 +1,100 @@
+"""Worker for the ci.sh plan-bytes pin (ISSUE 20): the env-world host
+exchange INTERPRETS the gradient-sync plan stamped on the optimizer
+(``dist_opt.update.exchange_plan``) — so the wire traffic the
+observability counters report per step must equal EXACTLY the plan's
+bucket payload sizes, and the per-step submit count must move one-for-one
+with the plan's bucket count (fusion_threshold=0 degrades to one submit
+per leaf; the delta is exactly the fused leaves). One planner, two
+executors: if the host loop ever grew a second bucket scan, these pins
+are where the drift shows up."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import training  # noqa: E402
+from horovod_tpu.obs.registry import registry  # noqa: E402
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=True):
+        return nn.Dense(10)(nn.relu(nn.Dense(32)(x)))
+
+
+def build(threshold):
+    state, dist_opt = training.create_train_state(
+        MLP(), jax.random.PRNGKey(0), jnp.zeros((2, 8)), optax.adam(1e-2),
+        fusion_threshold=threshold)
+    step = training.make_train_step(MLP(), dist_opt, donate=False)
+    return state, dist_opt, step
+
+
+def run_steps(state, step, n=2):
+    """Run n steps; return per-step (bytes, submits) counter deltas."""
+    reg = registry()
+    byte_c = reg.counter("hvd_collective_bytes_total")
+    sub_c = reg.counter("hvd_collective_submits_total")
+    rng = np.random.RandomState(1)  # same seed every rank = one batch
+    s = hvd.size()
+    deltas = []
+    prev_b, prev_s = byte_c.value, sub_c.value
+    for _ in range(n):
+        x = rng.randn(4 * s, 8).astype(np.float32)
+        y = rng.randint(0, 10, (4 * s,))
+        state, m = step(state, training.shard_batch((x, y)))
+        float(np.asarray(m["loss"]))  # block: counters bump in-step
+        deltas.append((byte_c.value - prev_b, sub_c.value - prev_s))
+        prev_b, prev_s = byte_c.value, sub_c.value
+    return state, deltas
+
+
+def main():
+    hvd.init()
+    w = hvd.size()
+
+    # 2 KiB threshold splits this tiny model's 4 fp32 leaves into
+    # multiple buckets — the pin is vacuous if everything fuses into one.
+    state, dist_opt, step = build(2048)
+    leaves = [np.asarray(l)
+              for l in jax.tree_util.tree_leaves(state.params)]
+    buckets, syncs = dist_opt.update.exchange_plan(leaves, world_size=w)
+    assert 1 < len(buckets) < len(leaves), buckets
+    assert all(s.denom == w and s.psum and not s.shard for s in syncs)
+    expected = sum(leaves[j].nbytes for b in buckets for j in b)
+
+    _, deltas = run_steps(state, step)
+    for nbytes, nsub in deltas:
+        # Reduced bytes == the plan's bucket payload sizes, exactly.
+        assert nbytes == expected, (nbytes, expected, buckets)
+        assert nsub >= len(buckets) + 1  # + metric submits (loss, ...)
+
+    # fusion_threshold=0: the stamped plan degrades to one bucket per
+    # leaf; the submit delta moves by exactly the previously-fused count
+    # while bytes are unchanged (same payloads, no padding in fp32).
+    state0, dist_opt0, step0 = build(0)
+    b0, _ = dist_opt0.update.exchange_plan(leaves, world_size=w)
+    assert len(b0) == len(leaves)
+    _, deltas0 = run_steps(state0, step0, n=1)
+    assert deltas0[0][0] == expected, (deltas0, expected)
+    assert deltas0[0][1] - deltas[-1][1] == len(leaves) - len(buckets)
+
+    if hvd.rank() == 0:
+        print(f"PLAN-BYTES OK: host loop wires exactly the planned "
+              f"{expected} bytes/step over {len(buckets)} buckets; "
+              f"threshold=0 adds {len(leaves) - len(buckets)} submits")
+
+
+if __name__ == "__main__":
+    main()
